@@ -1,0 +1,21 @@
+"""lightgbm_tpu: a TPU-native gradient-boosting framework.
+
+A from-scratch JAX/XLA re-design of the LightGBM surface (reference analyzed in
+SURVEY.md): histogram-based leaf-wise GBDT/DART/RF, the full objective/metric suite,
+LightGBM-compatible model text format and train()/predict() API — with binned features
+resident in TPU HBM, whole-tree growth inside jitted XLA programs, and distributed
+data-parallel training over `jax.sharding.Mesh` ICI/DCN collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+from .io.dataset import Dataset as _RawDataset  # internal binned dataset
+from .utils.log import LightGBMError, register_callback
+
+__all__ = [
+    "Config",
+    "LightGBMError",
+    "register_callback",
+    "__version__",
+]

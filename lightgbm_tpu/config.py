@@ -1,0 +1,398 @@
+"""Single-definition parameter/config system.
+
+Mirrors the reference's flat `struct Config` + generated alias table
+(ref: include/LightGBM/config.h:39, src/io/config_auto.cpp:10, src/io/config.cpp
+`Config::Set`/`KV2Map`/`KeepFirstValues`).  One declarative PARAMS table is the single
+source of truth: typed fields, defaults, and aliases.  First occurrence of a
+key (or any alias) wins; aliases normalize to the canonical name; unknown keys warn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .utils import log
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "+"):
+        return True
+    if s in ("false", "0", "no", "-"):
+        return False
+    log.fatal(f"Cannot parse bool value: {v}")
+
+
+def _to_int(v: Any) -> int:
+    if isinstance(v, bool):
+        return int(v)
+    return int(float(v)) if not isinstance(v, int) else v
+
+
+def _to_float(v: Any) -> float:
+    return float(v)
+
+
+def _to_str(v: Any) -> str:
+    return str(v)
+
+
+def _to_int_list(v: Any) -> List[int]:
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [int(float(x)) for x in s.split(",")]
+
+
+def _to_float_list(v: Any) -> List[float]:
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [float(x) for x in s.split(",")]
+
+
+def _to_str_list(v: Any) -> List[str]:
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [x for x in s.split(",") if x]
+
+
+_CONVERTERS = {
+    "bool": _to_bool,
+    "int": _to_int,
+    "float": _to_float,
+    "str": _to_str,
+    "int_list": _to_int_list,
+    "float_list": _to_float_list,
+    "str_list": _to_str_list,
+}
+
+# (name, type, default, aliases) — alias lists follow the reference's generated table
+# (ref: src/io/config_auto.cpp:10-210 GetAliasTable / config.h doc-comments).
+PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
+    # --- core ---
+    ("task", "str", "train", ("task_type",)),
+    ("objective", "str", "regression",
+     ("objective_type", "app", "application", "loss")),
+    ("boosting", "str", "gbdt", ("boosting_type", "boost")),
+    ("data_sample_strategy", "str", "bagging", ()),
+    ("data", "str", "", ("train", "train_data", "train_data_file", "data_filename")),
+    ("valid", "str_list", [], ("test", "valid_data", "valid_data_file", "test_data",
+                               "test_data_file", "valid_filenames")),
+    ("num_iterations", "int", 100,
+     ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round", "num_rounds",
+      "nrounds", "num_boost_round", "n_estimators", "max_iter")),
+    ("learning_rate", "float", 0.1, ("shrinkage_rate", "eta")),
+    ("num_leaves", "int", 31, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")),
+    ("tree_learner", "str", "serial", ("tree", "tree_type", "tree_learner_type")),
+    ("num_threads", "int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    ("device_type", "str", "tpu", ("device",)),
+    ("seed", "int", 0, ("random_seed", "random_state")),
+    ("deterministic", "bool", False, ()),
+    # --- learning control ---
+    ("force_col_wise", "bool", False, ()),
+    ("force_row_wise", "bool", False, ()),
+    ("histogram_pool_size", "float", -1.0, ("hist_pool_size",)),
+    ("max_depth", "int", -1, ()),
+    ("min_data_in_leaf", "int", 20,
+     ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf")),
+    ("min_sum_hessian_in_leaf", "float", 1e-3,
+     ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight")),
+    ("bagging_fraction", "float", 1.0, ("sub_row", "subsample", "bagging")),
+    ("pos_bagging_fraction", "float", 1.0,
+     ("pos_sub_row", "pos_subsample", "pos_bagging")),
+    ("neg_bagging_fraction", "float", 1.0,
+     ("neg_sub_row", "neg_subsample", "neg_bagging")),
+    ("bagging_freq", "int", 0, ("subsample_freq",)),
+    ("bagging_seed", "int", 3, ("bagging_fraction_seed",)),
+    ("bagging_by_query", "bool", False, ()),
+    ("feature_fraction", "float", 1.0, ("sub_feature", "colsample_bytree")),
+    ("feature_fraction_bynode", "float", 1.0,
+     ("sub_feature_bynode", "colsample_bynode")),
+    ("feature_fraction_seed", "int", 2, ()),
+    ("extra_trees", "bool", False, ("extra_tree",)),
+    ("extra_seed", "int", 6, ()),
+    ("early_stopping_round", "int", 0,
+     ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    ("early_stopping_min_delta", "float", 0.0, ()),
+    ("first_metric_only", "bool", False, ()),
+    ("max_delta_step", "float", 0.0, ("max_tree_output", "max_leaf_output")),
+    ("lambda_l1", "float", 0.0, ("reg_alpha", "l1_regularization")),
+    ("lambda_l2", "float", 0.0, ("reg_lambda", "lambda", "l2_regularization")),
+    ("linear_lambda", "float", 0.0, ()),
+    ("min_gain_to_split", "float", 0.0, ("min_split_gain",)),
+    ("drop_rate", "float", 0.1, ("rate_drop",)),
+    ("max_drop", "int", 50, ()),
+    ("skip_drop", "float", 0.5, ()),
+    ("xgboost_dart_mode", "bool", False, ()),
+    ("uniform_drop", "bool", False, ()),
+    ("drop_seed", "int", 4, ()),
+    ("top_rate", "float", 0.2, ()),
+    ("other_rate", "float", 0.1, ()),
+    ("min_data_per_group", "int", 100, ()),
+    ("max_cat_threshold", "int", 32, ()),
+    ("cat_l2", "float", 10.0, ()),
+    ("cat_smooth", "float", 10.0, ()),
+    ("max_cat_to_onehot", "int", 4, ()),
+    ("top_k", "int", 20, ("topk",)),
+    ("monotone_constraints", "int_list", [],
+     ("mc", "monotone_constraint", "monotonic_cst")),
+    ("monotone_constraints_method", "str", "basic", ("monotone_constraining_method", "mc_method")),
+    ("monotone_penalty", "float", 0.0, ("monotone_splits_penalty", "ms_penalty", "mc_penalty")),
+    ("feature_contri", "float_list", [], ("feature_contrib", "fc", "fp", "feature_penalty")),
+    ("forcedsplits_filename", "str", "", ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    ("refit_decay_rate", "float", 0.9, ()),
+    ("cegb_tradeoff", "float", 1.0, ()),
+    ("cegb_penalty_split", "float", 0.0, ()),
+    ("cegb_penalty_feature_lazy", "float_list", [], ()),
+    ("cegb_penalty_feature_coupled", "float_list", [], ()),
+    ("path_smooth", "float", 0.0, ()),
+    ("interaction_constraints", "str", "", ()),
+    ("verbosity", "int", 1, ("verbose",)),
+    ("input_model", "str", "", ("model_input", "model_in")),
+    ("output_model", "str", "LightGBM_model.txt", ("model_output", "model_out")),
+    ("saved_feature_importance_type", "int", 0, ()),
+    ("snapshot_freq", "int", -1, ("save_period",)),
+    ("use_quantized_grad", "bool", False, ()),
+    ("num_grad_quant_bins", "int", 4, ()),
+    ("quant_train_renew_leaf", "bool", False, ()),
+    ("stochastic_rounding", "bool", True, ()),
+    # --- dataset ---
+    ("linear_tree", "bool", False, ("linear_trees",)),
+    ("max_bin", "int", 255, ("max_bins",)),
+    ("max_bin_by_feature", "int_list", [], ()),
+    ("min_data_in_bin", "int", 3, ()),
+    ("bin_construct_sample_cnt", "int", 200000, ("subsample_for_bin",)),
+    ("data_random_seed", "int", 1, ("data_seed",)),
+    ("is_enable_sparse", "bool", True, ("is_sparse", "enable_sparse", "sparse")),
+    ("enable_bundle", "bool", True, ("is_enable_bundle", "bundle")),
+    ("use_missing", "bool", True, ()),
+    ("zero_as_missing", "bool", False, ()),
+    ("feature_pre_filter", "bool", True, ()),
+    ("pre_partition", "bool", False, ("is_pre_partition",)),
+    ("two_round", "bool", False, ("two_round_loading", "use_two_round_loading")),
+    ("header", "bool", False, ("has_header",)),
+    ("label_column", "str", "", ("label",)),
+    ("weight_column", "str", "", ("weight",)),
+    ("group_column", "str", "",
+     ("group", "group_id", "query_column", "query", "query_id")),
+    ("ignore_column", "str", "", ("ignore_feature", "blacklist")),
+    ("categorical_feature", "str", "",
+     ("cat_feature", "categorical_column", "cat_column", "categorical_features")),
+    ("forcedbins_filename", "str", "", ()),
+    ("save_binary", "bool", False, ("is_save_binary", "is_save_binary_file")),
+    ("precise_float_parser", "bool", False, ()),
+    ("parser_config_file", "str", "", ()),
+    # --- predict ---
+    ("start_iteration_predict", "int", 0, ()),
+    ("num_iteration_predict", "int", -1, ()),
+    ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    ("predict_leaf_index", "bool", False, ("is_predict_leaf_index", "leaf_index")),
+    ("predict_contrib", "bool", False, ("is_predict_contrib", "contrib")),
+    ("predict_disable_shape_check", "bool", False, ()),
+    ("pred_early_stop", "bool", False, ()),
+    ("pred_early_stop_freq", "int", 10, ()),
+    ("pred_early_stop_margin", "float", 10.0, ()),
+    ("output_result", "str", "LightGBM_predict_result.txt",
+     ("predict_result", "prediction_result", "predict_name", "pred_name", "name_pred")),
+    # --- convert ---
+    ("convert_model_language", "str", "", ()),
+    ("convert_model", "str", "gbdt_prediction.cpp", ("convert_model_file",)),
+    # --- objective ---
+    ("objective_seed", "int", 5, ()),
+    ("num_class", "int", 1, ("num_classes",)),
+    ("is_unbalance", "bool", False, ("unbalance", "unbalanced_sets")),
+    ("scale_pos_weight", "float", 1.0, ()),
+    ("sigmoid", "float", 1.0, ()),
+    ("boost_from_average", "bool", True, ()),
+    ("reg_sqrt", "bool", False, ()),
+    ("alpha", "float", 0.9, ()),
+    ("fair_c", "float", 1.0, ()),
+    ("poisson_max_delta_step", "float", 0.7, ()),
+    ("tweedie_variance_power", "float", 1.5, ()),
+    ("lambdarank_truncation_level", "int", 30, ()),
+    ("lambdarank_norm", "bool", True, ()),
+    ("label_gain", "float_list", [], ()),
+    ("lambdarank_position_bias_regularization", "float", 0.0, ()),
+    # --- metric ---
+    ("metric", "str_list", [], ("metrics", "metric_types")),
+    ("metric_freq", "int", 1, ("output_freq",)),
+    ("is_provide_training_metric", "bool", False,
+     ("training_metric", "is_training_metric", "train_metric")),
+    ("eval_at", "int_list", [1, 2, 3, 4, 5],
+     ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    ("multi_error_top_k", "int", 1, ()),
+    ("auc_mu_weights", "float_list", [], ()),
+    # --- network ---
+    ("num_machines", "int", 1, ("num_machine",)),
+    ("local_listen_port", "int", 12400, ("local_port", "port")),
+    ("time_out", "int", 120, ()),
+    ("machine_list_filename", "str", "",
+     ("machine_list_file", "machine_list", "mlist")),
+    ("machines", "str", "", ("workers", "nodes")),
+    # --- device/tpu ---
+    ("gpu_platform_id", "int", -1, ()),
+    ("gpu_device_id", "int", -1, ()),
+    ("gpu_use_dp", "bool", False, ()),
+    ("num_gpu", "int", 1, ()),
+    ("tpu_mesh_shape", "int_list", [], ()),  # TPU-native: data-parallel mesh shape
+    ("tpu_donate_buffers", "bool", True, ()),  # TPU-native: donate score buffers in jit
+]
+
+_CANONICAL: Dict[str, Tuple[str, str]] = {}
+for _name, _typ, _default, _aliases in PARAMS:
+    _CANONICAL[_name] = (_name, _typ)
+    for _a in _aliases:
+        _CANONICAL[_a] = (_name, _typ)
+
+
+def alias_table() -> Dict[str, str]:
+    """alias -> canonical name map (ref: config_auto.cpp GetAliasTable)."""
+    return {k: v[0] for k, v in _CANONICAL.items()}
+
+
+def parameter_types() -> Dict[str, str]:
+    return {name: typ for name, typ, _, _ in PARAMS}
+
+
+def kv2map(args: List[str]) -> Dict[str, str]:
+    """Parse 'key=value' strings; first occurrence wins
+    (ref: config.cpp KV2Map + KeepFirstValues)."""
+    out: Dict[str, str] = {}
+    for arg in args:
+        arg = arg.strip()
+        if not arg or arg.startswith("#"):
+            continue
+        if "=" not in arg:
+            log.warning(f"Unknown option: {arg}")
+            continue
+        k, v = arg.split("=", 1)
+        k = k.strip()
+        v = v.split("#", 1)[0].strip()
+        if k in out:
+            log.warning(f"{k} is set multiple times, keeping the first value")
+            continue
+        out[k] = v
+    return out
+
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "custom": "custom", "none": "custom", "null": "custom", "na": "custom",
+}
+
+
+def normalize_objective(name: str) -> str:
+    name = name.strip().lower()
+    if name in _OBJECTIVE_ALIASES:
+        return _OBJECTIVE_ALIASES[name]
+    log.fatal(f"Unknown objective: {name}")
+
+
+class Config:
+    """Flat typed config (ref: config.h:39 `struct Config`)."""
+
+    def __init__(self, params: Optional[Union[Dict[str, Any], List[str], str]] = None,
+                 **kwargs):
+        for name, typ, default, _aliases in PARAMS:
+            setattr(self, name, default() if callable(default)
+                    else (list(default) if isinstance(default, list) else default))
+        self.raw_params: Dict[str, Any] = {}
+        merged: Dict[str, Any] = {}
+        if isinstance(params, str):
+            params = [p for p in params.replace("\n", " ").split(" ") if p]
+        if isinstance(params, list):
+            merged.update(kv2map(params))
+        elif isinstance(params, dict):
+            merged.update(params)
+        merged.update(kwargs)
+        self.update(merged)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        seen_canonical: Dict[str, str] = {}
+        for key, value in params.items():
+            key_norm = key.strip().lower() if isinstance(key, str) else key
+            if key_norm not in _CANONICAL:
+                log.warning(f"Unknown parameter: {key}")
+                self.raw_params[key] = value
+                continue
+            canonical, typ = _CANONICAL[key_norm]
+            if value is None:
+                continue
+            if canonical in seen_canonical:
+                log.warning(
+                    f"{canonical} is set with {seen_canonical[canonical]} and {key}, "
+                    f"current value ({getattr(self, canonical)}) is kept")
+                continue
+            seen_canonical[canonical] = key
+            setattr(self, canonical, _CONVERTERS[typ](value))
+            self.raw_params[canonical] = value
+        self._post_process()
+
+    def _post_process(self) -> None:
+        log.set_verbosity(self.verbosity)
+        obj = normalize_objective(self.objective) if self.objective else "custom"
+        # objective-implied settings (ref: config.cpp Config::Set heuristics)
+        if obj in ("multiclass", "multiclassova") and self.num_class < 2:
+            log.fatal("num_class should be >=2 for multiclass objectives")
+        if obj == "binary":
+            self.num_class = 1
+        self.objective = obj
+        self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
+                         "rf": "rf", "random_forest": "rf", "goss": "goss",
+                         }.get(self.boosting.strip().lower(), self.boosting)
+        if self.boosting == "goss":
+            # legacy alias: boosting=goss means gbdt + goss sampling (ref: boosting.cpp:26)
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name, _, _, _ in PARAMS}
+
+    def changed_params(self) -> Dict[str, Any]:
+        out = {}
+        for name, typ, default, _ in PARAMS:
+            cur = getattr(self, name)
+            if cur != default:
+                out[name] = cur
+        return out
+
+
+def read_config_file(path: str) -> Dict[str, str]:
+    """Parse a CLI config file of `key = value` lines
+    (ref: application.cpp:50-86 LoadParameters)."""
+    lines = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            lines.append(line.replace(" = ", "=").replace("= ", "=").replace(" =", "="))
+    return kv2map(lines)

@@ -1,0 +1,5 @@
+from .binning import BinMapper
+from .dataset import Dataset, Metadata, load_dataset_from_file
+from .parser import parse_file
+
+__all__ = ["BinMapper", "Dataset", "Metadata", "load_dataset_from_file", "parse_file"]

@@ -1,0 +1,363 @@
+"""Dataset: binned feature tensors + Metadata, ready for TPU residence.
+
+TPU-first redesign of the reference's Dataset/FeatureGroup/DatasetLoader stack
+(ref: include/LightGBM/dataset.h:486, src/io/dataset_loader.cpp): instead of
+per-feature Bin objects with sparse/dense variants, all used features are binned into
+one dense feature-major int32 matrix `binned [F_used, n]` (uint8-sized bins in
+practice; int32 keeps XLA gathers simple — the histogram kernels re-cast).  Trivial
+features are dropped at construction and restored at prediction/model-output time via
+`used_feature_map`, mirroring the reference's inner-feature mapping
+(ref: dataset.h:556-647 used_feature_map_/feature2group_).
+
+Sampling-based bin construction follows DatasetLoader::ConstructFromSampleData
+(ref: dataset_loader.cpp:593).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+
+
+class Metadata:
+    """Labels / weights / init scores / query boundaries / positions
+    (ref: include/LightGBM/dataset.h:47-399, src/io/metadata.cpp)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: np.ndarray = np.zeros(num_data, dtype=np.float32)
+        self.weight: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries+1]
+        self.position: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            log.fatal(f"Length of label ({len(label)}) != num_data ({self.num_data})")
+        self.label = label
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            log.fatal(f"Length of weight ({len(weight)}) != num_data ({self.num_data})")
+        self.weight = weight
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        self.init_score = init_score
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """`group` is sizes per query (LightGBM convention); converts to boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        if group.sum() != self.num_data:
+            log.fatal(f"Sum of query counts ({group.sum()}) != num_data ({self.num_data})")
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(group)]).astype(np.int32)
+
+    def set_position(self, position: Optional[Sequence[int]]) -> None:
+        self.position = None if position is None else np.asarray(position, dtype=np.int32)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """Binned training data (ref: include/LightGBM/dataset.h:486 `class Dataset`)."""
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.bin_mappers: List[BinMapper] = []          # per original feature
+        self.used_feature_map: List[int] = []            # original -> inner (-1 trivial)
+        self.used_features: List[int] = []               # inner -> original
+        self.binned: Optional[np.ndarray] = None         # int32 [F_used, n]
+        self.metadata: Optional[Metadata] = None
+        self.max_bin: int = 255
+        self.raw_data: Optional[np.ndarray] = None       # kept for linear trees
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    def num_bin(self, inner_feature: int) -> int:
+        return self.bin_mappers[self.used_features[inner_feature]].num_bin
+
+    @property
+    def max_num_bin(self) -> int:
+        if not self.used_features:
+            return 1
+        return max(self.bin_mappers[f].num_bin for f in self.used_features)
+
+    def inner_feature_index(self, original: int) -> int:
+        return self.used_feature_map[original]
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def construct_from_arrays(
+            cls,
+            data: np.ndarray,
+            label: Optional[Sequence[float]] = None,
+            weight: Optional[Sequence[float]] = None,
+            group: Optional[Sequence[int]] = None,
+            init_score: Optional[Sequence[float]] = None,
+            max_bin: int = 255,
+            min_data_in_bin: int = 3,
+            min_data_in_leaf: int = 20,
+            bin_construct_sample_cnt: int = 200000,
+            categorical_feature: Optional[Sequence[int]] = None,
+            feature_names: Optional[Sequence[str]] = None,
+            use_missing: bool = True,
+            zero_as_missing: bool = False,
+            feature_pre_filter: bool = True,
+            seed: int = 1,
+            keep_raw_data: bool = False,
+            reference: Optional["Dataset"] = None,
+            max_bin_by_feature: Optional[Sequence[int]] = None) -> "Dataset":
+        """Build a Dataset from a dense float matrix
+        (ref: dataset_loader.cpp:593 ConstructFromSampleData + :1263 ExtractFeatures).
+
+        When `reference` is given, reuse its bin mappers (validation-set path,
+        ref: basic.py create_valid / LoadFromFileAlignWithOtherDataset).
+        """
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim != 2:
+            log.fatal("Training data must be 2-dimensional")
+        n, num_features = data.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = num_features
+        ds.max_bin = max_bin
+        if feature_names is not None:
+            ds.feature_names = [str(s) for s in feature_names]
+        else:
+            ds.feature_names = [f"Column_{i}" for i in range(num_features)]
+
+        if reference is not None:
+            if reference.num_total_features != num_features:
+                log.fatal("Validation data feature count mismatch with reference Dataset")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.used_features = reference.used_features
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+        else:
+            cat_set = set(categorical_feature or [])
+            # sample rows for bin finding (ref: config `bin_construct_sample_cnt`)
+            if n > bin_construct_sample_cnt:
+                rng = np.random.RandomState(seed)
+                sample_idx = np.sort(rng.choice(n, bin_construct_sample_cnt, replace=False))
+                sample = data[sample_idx]
+            else:
+                sample = data
+            total_sample_cnt = len(sample)
+            ds.bin_mappers = []
+            for f in range(num_features):
+                col = sample[:, f]
+                # reference samples *non-zero* values; zeros are implied counts
+                nonzero = col[~((col == 0) | np.isnan(col))]
+                nan_vals = col[np.isnan(col)]
+                vals = np.concatenate([nonzero, nan_vals])
+                mapper = BinMapper()
+                fmax_bin = (int(max_bin_by_feature[f])
+                            if max_bin_by_feature else max_bin)
+                mapper.find_bin(
+                    vals, total_sample_cnt, fmax_bin,
+                    min_data_in_bin=min_data_in_bin,
+                    min_split_data=min_data_in_leaf,
+                    pre_filter=feature_pre_filter,
+                    bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
+                    use_missing=use_missing, zero_as_missing=zero_as_missing)
+                ds.bin_mappers.append(mapper)
+            ds.used_feature_map = []
+            ds.used_features = []
+            for f, m in enumerate(ds.bin_mappers):
+                if m.is_trivial:
+                    ds.used_feature_map.append(-1)
+                else:
+                    ds.used_feature_map.append(len(ds.used_features))
+                    ds.used_features.append(f)
+
+        # bin every used feature (ref: ExtractFeaturesFromMemory PushOneRow)
+        binned = np.empty((len(ds.used_features), n), dtype=np.int32)
+        for inner, f in enumerate(ds.used_features):
+            binned[inner] = ds.bin_mappers[f].values_to_bins(data[:, f])
+        ds.binned = binned
+
+        md = Metadata(n)
+        if label is not None:
+            md.set_label(label)
+        md.set_weight(weight)
+        md.set_group(group)
+        md.set_init_score(init_score)
+        ds.metadata = md
+        if keep_raw_data:
+            ds.raw_data = data
+        return ds
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data: np.ndarray, label=None, weight=None, group=None,
+                     init_score=None) -> "Dataset":
+        return Dataset.construct_from_arrays(
+            data, label=label, weight=weight, group=group, init_score=init_score,
+            reference=self)
+
+    # ------------------------------------------------------------------
+    def copy_subrow(self, used_indices: np.ndarray) -> "Dataset":
+        """Row-subset copy for bagging (ref: dataset.h:660 CopySubrow)."""
+        used_indices = np.asarray(used_indices, dtype=np.int64)
+        sub = Dataset()
+        sub.num_data = len(used_indices)
+        sub.num_total_features = self.num_total_features
+        sub.feature_names = self.feature_names
+        sub.bin_mappers = self.bin_mappers
+        sub.used_feature_map = self.used_feature_map
+        sub.used_features = self.used_features
+        sub.max_bin = self.max_bin
+        sub.binned = self.binned[:, used_indices]
+        md = Metadata(sub.num_data)
+        src = self.metadata
+        md.set_label(src.label[used_indices])
+        if src.weight is not None:
+            md.set_weight(src.weight[used_indices])
+        if src.init_score is not None:
+            if len(src.init_score) == self.num_data:
+                md.set_init_score(src.init_score[used_indices])
+            else:  # num_data * num_class layout (ref: metadata.cpp init_score)
+                num_class = len(src.init_score) // self.num_data
+                stacked = src.init_score.reshape(num_class, self.num_data)
+                md.set_init_score(stacked[:, used_indices].reshape(-1))
+        if src.query_boundaries is not None:
+            # rebuild query boundaries from per-row query ids of the selected rows
+            # (ref: metadata.cpp Metadata::Init(metadata, used_indices))
+            qid = np.searchsorted(src.query_boundaries, used_indices, side="right") - 1
+            counts = np.bincount(qid, minlength=src.num_queries)
+            counts = counts[counts > 0]
+            md.query_boundaries = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int32)
+        if src.position is not None:
+            md.set_position(src.position[used_indices])
+        sub.metadata = md
+        if self.raw_data is not None:
+            sub.raw_data = self.raw_data[used_indices]
+        return sub
+
+    # ------------------------------------------------------------------
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info_str() for m in self.bin_mappers]
+
+    def save_binary(self, path: str) -> None:
+        """Binary dataset checkpoint (ref: dataset.h:691 SaveBinaryFile)."""
+        md = self.metadata
+        np.savez_compressed(
+            path,
+            binned=self.binned,
+            label=md.label,
+            weight=md.weight if md.weight is not None else np.array([]),
+            init_score=md.init_score if md.init_score is not None else np.array([]),
+            query_boundaries=(md.query_boundaries if md.query_boundaries is not None
+                              else np.array([], dtype=np.int32)),
+            meta_json=np.frombuffer(json.dumps({
+                "num_data": self.num_data,
+                "num_total_features": self.num_total_features,
+                "feature_names": self.feature_names,
+                "used_features": self.used_features,
+                "used_feature_map": self.used_feature_map,
+                "max_bin": self.max_bin,
+                "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            }).encode(), dtype=np.uint8))
+
+    @classmethod
+    def load_binary(cls, path: str) -> "Dataset":
+        """(ref: dataset_loader.cpp:417 LoadFromBinFile)."""
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(bytes(z["meta_json"]).decode())
+        ds = cls()
+        ds.num_data = meta["num_data"]
+        ds.num_total_features = meta["num_total_features"]
+        ds.feature_names = meta["feature_names"]
+        ds.used_features = meta["used_features"]
+        ds.used_feature_map = meta["used_feature_map"]
+        ds.max_bin = meta["max_bin"]
+        ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+        ds.binned = z["binned"]
+        md = Metadata(ds.num_data)
+        md.set_label(z["label"])
+        if len(z["weight"]):
+            md.set_weight(z["weight"])
+        if len(z["init_score"]):
+            md.set_init_score(z["init_score"])
+        if len(z["query_boundaries"]):
+            md.query_boundaries = z["query_boundaries"].astype(np.int32)
+        ds.metadata = md
+        return ds
+
+
+def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = None,
+                           reference: Optional[Dataset] = None) -> Dataset:
+    """File -> Dataset pipeline (ref: dataset_loader.cpp LoadFromFile)."""
+    from ..config import Config
+    from .parser import parse_file
+    cfg = config_params if isinstance(config_params, Config) else Config(config_params or {})
+    if path.endswith(".bin.npz") or path.endswith(".bin"):
+        try:
+            return Dataset.load_binary(path)
+        except (FileNotFoundError, OSError, KeyError, ValueError):
+            pass
+    feats, labels, names = parse_file(path, has_header=cfg.header,
+                                      label_column=cfg.label_column)
+    weight = None
+    try:
+        with open(path + ".weight") as f:
+            weight = np.array([float(x) for x in f.read().split()], dtype=np.float32)
+    except FileNotFoundError:
+        pass
+    group = None
+    try:
+        with open(path + ".query") as f:
+            group = np.array([int(x) for x in f.read().split()], dtype=np.int64)
+    except FileNotFoundError:
+        pass
+    cat_features: List[int] = []
+    if cfg.categorical_feature:
+        for tok in str(cfg.categorical_feature).split(","):
+            tok = tok.strip()
+            if tok.startswith("name:"):
+                if names and tok[5:] in names:
+                    cat_features.append(names.index(tok[5:]))
+            elif tok:
+                cat_features.append(int(tok))
+    if reference is not None:
+        ds = reference.create_valid(feats, label=labels, weight=weight, group=group)
+    else:
+        ds = Dataset.construct_from_arrays(
+            feats, label=labels, weight=weight, group=group,
+            max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+            categorical_feature=cat_features,
+            feature_names=names, use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            feature_pre_filter=cfg.feature_pre_filter,
+            seed=cfg.data_random_seed,
+            keep_raw_data=cfg.linear_tree)
+    return ds

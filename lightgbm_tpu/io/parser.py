@@ -1,0 +1,101 @@
+"""Text parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Mirrors the reference parser behavior (ref: src/io/parser.cpp:1-395): detect the
+delimiter and sparse (LibSVM `idx:value`) format from the first lines, resolve the
+label column, return dense float64 rows (NaN for missing).  NumPy-vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+def _detect_format(sample_lines: List[str]) -> Tuple[str, str]:
+    """Return (kind, delimiter) where kind in {'libsvm','dense'}
+    (ref: parser.cpp GetDelimiter/DecideParser)."""
+    # libsvm if any token beyond the first contains ':'
+    for line in sample_lines:
+        toks = line.replace("\t", " ").replace(",", " ").split()
+        if any(":" in t for t in toks[1:]):
+            return "libsvm", " "
+    first = sample_lines[0]
+    for delim in ("\t", ",", " "):
+        if delim in first:
+            return "dense", delim
+    return "dense", "\t"
+
+
+def parse_file(path: str, has_header: bool = False,
+               label_column: str = "") -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a data file -> (features [n, F] float64 with NaN missing, labels [n],
+    feature_names or None).
+
+    label_column: '' (first column), 'N' (index), or 'name:COL' (header name)
+    (ref: dataset_loader.cpp:35-130 SetHeader label resolution).
+    """
+    with open(path) as f:
+        lines = [ln.rstrip("\n\r") for ln in f if ln.strip()]
+    if not lines:
+        log.fatal(f"Empty data file: {path}")
+    header_names: Optional[List[str]] = None
+    if has_header:
+        header_line = lines[0]
+        lines = lines[1:]
+        if not lines:
+            log.fatal(f"Data file has a header but no data rows: {path}")
+    kind, delim = _detect_format(lines[:32])
+    if has_header:
+        for d in ("\t", ",", " "):
+            if d in header_line:
+                header_names = header_line.split(d)
+                break
+        else:
+            header_names = [header_line]
+
+    label_idx = 0
+    if label_column:
+        if label_column.startswith("name:"):
+            name = label_column[5:]
+            if header_names is None or name not in header_names:
+                log.fatal(f"Label column '{name}' not found in header")
+            label_idx = header_names.index(name)
+        else:
+            label_idx = int(label_column)
+
+    if kind == "libsvm":
+        labels = np.empty(len(lines), dtype=np.float64)
+        rows: List[List[Tuple[int, float]]] = []
+        max_idx = -1
+        for i, line in enumerate(lines):
+            toks = line.split()
+            labels[i] = float(toks[0])
+            row = []
+            for t in toks[1:]:
+                k, v = t.split(":", 1)
+                ki = int(k)
+                row.append((ki, float(v)))
+                max_idx = max(max_idx, ki)
+            rows.append(row)
+        feats = np.zeros((len(lines), max_idx + 1), dtype=np.float64)
+        for i, row in enumerate(rows):
+            for k, v in row:
+                feats[i, k] = v
+        if header_names is not None:
+            header_names = None  # libsvm ignores header names for features
+        return feats, labels, None
+
+    # dense: vectorized via np.genfromtxt-style manual split (handles '' -> NaN)
+    mat = np.array(
+        [[(np.nan if tok == "" or tok.lower() in ("na", "nan", "null") else float(tok))
+          for tok in line.split(delim)] for line in lines], dtype=np.float64)
+    labels = mat[:, label_idx].copy()
+    feats = np.delete(mat, label_idx, axis=1)
+    if header_names is not None:
+        feat_names = [h for i, h in enumerate(header_names) if i != label_idx]
+    else:
+        feat_names = None
+    return feats, labels, feat_names

@@ -1,0 +1,377 @@
+"""Array-encoded decision tree + vectorized prediction + model text (de)serialization.
+
+Mirrors the reference Tree (ref: include/LightGBM/tree.h:25, src/io/tree.cpp): internal
+nodes live in parallel arrays sized num_leaves-1, leaves in arrays sized num_leaves;
+child pointers use the `~leaf` encoding (negative = leaf index bitwise-complemented).
+decision_type packs categorical(bit0) / default_left(bit1) / missing_type(bits 2-3)
+(ref: tree.h:19-20,260-278).  Prediction is vectorized over rows (NumPy host path);
+the jitted training/prediction paths use the same arrays as jnp tensors.
+
+Text format is line-compatible with the reference's `Tree=N` blocks
+(ref: src/io/tree.cpp:339-397 ToString, Tree::Tree(const char*) parser).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io.binning import (K_ZERO_THRESHOLD, MISSING_NAN, MISSING_NONE,
+                          MISSING_ZERO)
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+_K_MAX_VAL = float(np.finfo(np.float64).max)
+
+
+def _fmt(v: float, high: bool = False) -> str:
+    """LightGBM-style number formatting (ref: common.h ArrayToString)."""
+    if high:
+        s = repr(float(v))
+        if s.endswith(".0"):
+            s = s[:-2]
+        return s
+    return f"{float(v):g}"
+
+
+class Tree:
+    """One decision tree (ref: tree.h:25 `class Tree`)."""
+
+    def __init__(self, max_leaves: int, track_branch_features: bool = False,
+                 is_linear: bool = False):
+        self.max_leaves = max_leaves
+        self.num_leaves = 1
+        n = max(max_leaves - 1, 1)
+        self.split_feature = np.zeros(n, dtype=np.int32)        # original feature index
+        self.split_feature_inner = np.zeros(n, dtype=np.int32)  # inner (used) index
+        self.split_gain = np.zeros(n, dtype=np.float32)
+        self.threshold = np.zeros(n, dtype=np.float64)          # real-valued
+        self.threshold_in_bin = np.zeros(n, dtype=np.int32)
+        self.decision_type = np.zeros(n, dtype=np.int8)
+        self.left_child = np.zeros(n, dtype=np.int32)
+        self.right_child = np.zeros(n, dtype=np.int32)
+        self.internal_value = np.zeros(n, dtype=np.float64)
+        self.internal_weight = np.zeros(n, dtype=np.float64)
+        self.internal_count = np.zeros(n, dtype=np.int64)
+        self.leaf_value = np.zeros(max_leaves, dtype=np.float64)
+        self.leaf_weight = np.zeros(max_leaves, dtype=np.float64)
+        self.leaf_count = np.zeros(max_leaves, dtype=np.int64)
+        self.leaf_parent = np.full(max_leaves, -1, dtype=np.int32)
+        self.leaf_depth = np.zeros(max_leaves, dtype=np.int32)
+        # categorical split storage (ref: tree.h cat_boundaries_/cat_threshold_)
+        self.num_cat = 0
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []          # uint32 bitset words (real values)
+        self.cat_boundaries_inner: List[int] = [0]
+        self.cat_threshold_inner: List[int] = []    # uint32 bitset words (bins)
+        self.shrinkage = 1.0
+        self.is_linear = is_linear
+
+    # ------------------------------------------------------------------
+    def split(self, leaf: int, inner_feature: int, real_feature: int,
+              threshold_bin: int, threshold_double: float,
+              left_value: float, right_value: float,
+              left_cnt: int, right_cnt: int,
+              left_weight: float, right_weight: float, gain: float,
+              missing_type: int, default_left: bool) -> int:
+        """Numerical split of `leaf`; returns the new internal node index
+        (ref: tree.h:415 Split + tree.cpp Tree::Split)."""
+        new_node = self.num_leaves - 1
+        dtype = 0
+        if default_left:
+            dtype |= K_DEFAULT_LEFT_MASK
+        dtype |= (missing_type & 3) << 2
+        self.decision_type[new_node] = dtype
+        self._split_common(new_node, leaf, inner_feature, real_feature,
+                           left_value, right_value, left_cnt, right_cnt,
+                           left_weight, right_weight, gain)
+        self.threshold_in_bin[new_node] = threshold_bin
+        self.threshold[new_node] = threshold_double
+        return new_node
+
+    def split_categorical(self, leaf: int, inner_feature: int, real_feature: int,
+                          bins_in_left: List[int], cats_in_left: List[int],
+                          left_value: float, right_value: float,
+                          left_cnt: int, right_cnt: int,
+                          left_weight: float, right_weight: float, gain: float,
+                          missing_type: int) -> int:
+        """Categorical split: left iff category in bitset (ref: tree.h SplitCategorical)."""
+        new_node = self.num_leaves - 1
+        self.decision_type[new_node] = K_CATEGORICAL_MASK | ((missing_type & 3) << 2)
+        self._split_common(new_node, leaf, inner_feature, real_feature,
+                           left_value, right_value, left_cnt, right_cnt,
+                           left_weight, right_weight, gain)
+        self.threshold_in_bin[new_node] = self.num_cat
+        self.threshold[new_node] = self.num_cat
+        bitset = _to_bitset(cats_in_left)
+        bitset_inner = _to_bitset(bins_in_left)
+        self.cat_threshold.extend(bitset)
+        self.cat_boundaries.append(len(self.cat_threshold))
+        self.cat_threshold_inner.extend(bitset_inner)
+        self.cat_boundaries_inner.append(len(self.cat_threshold_inner))
+        self.num_cat += 1
+        return new_node
+
+    def _split_common(self, new_node: int, leaf: int, inner_feature: int,
+                      real_feature: int, left_value: float, right_value: float,
+                      left_cnt: int, right_cnt: int, left_weight: float,
+                      right_weight: float, gain: float) -> None:
+        new_leaf = self.num_leaves
+        parent = self.leaf_parent[leaf]
+        if parent >= 0:
+            if self.left_child[parent] == ~leaf:
+                self.left_child[parent] = new_node
+            else:
+                self.right_child[parent] = new_node
+        self.split_feature_inner[new_node] = inner_feature
+        self.split_feature[new_node] = real_feature
+        self.split_gain[new_node] = gain
+        self.left_child[new_node] = ~leaf
+        self.right_child[new_node] = ~new_leaf
+        self.internal_value[new_node] = self.leaf_value[leaf]
+        self.internal_weight[new_node] = left_weight + right_weight
+        self.internal_count[new_node] = left_cnt + right_cnt
+        depth = self.leaf_depth[leaf]
+        self.leaf_value[leaf] = _clip_leaf(left_value)
+        self.leaf_weight[leaf] = left_weight
+        self.leaf_count[leaf] = left_cnt
+        self.leaf_value[new_leaf] = _clip_leaf(right_value)
+        self.leaf_weight[new_leaf] = right_weight
+        self.leaf_count[new_leaf] = right_cnt
+        self.leaf_parent[leaf] = new_node
+        self.leaf_parent[new_leaf] = new_node
+        self.leaf_depth[leaf] = depth + 1
+        self.leaf_depth[new_leaf] = depth + 1
+        self.num_leaves += 1
+
+    # ------------------------------------------------------------------
+    def apply_shrinkage(self, rate: float) -> None:
+        """(ref: tree.h:187 Shrinkage)."""
+        self.leaf_value[:self.num_leaves] *= rate
+        self.internal_value[:max(self.num_leaves - 1, 0)] *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        """(ref: tree.h:201 AddBias)."""
+        self.leaf_value[:self.num_leaves] += val
+        self.internal_value[:max(self.num_leaves - 1, 0)] += val
+        self.shrinkage = 1.0
+
+    def set_leaf_output(self, leaf: int, value: float) -> None:
+        self.leaf_value[leaf] = _clip_leaf(value)
+
+    # ------------------------------------------------------------------
+    def get_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized leaf assignment for raw feature rows [n, F_total]
+        (ref: tree.h:422 GetLeaf)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)  # >=0 internal, <0 => leaf ~idx
+        for _ in range(self.num_leaves):  # depth bound
+            active = node >= 0
+            if not active.any():
+                break
+            nd = node[active]
+            fvals = X[active, self.split_feature[nd]]
+            go_left = self._decision(fvals, nd)
+            node[active] = np.where(go_left, self.left_child[nd], self.right_child[nd])
+        return (~node).astype(np.int32)
+
+    def _decision(self, fvals: np.ndarray, nodes: np.ndarray) -> np.ndarray:
+        dt = self.decision_type[nodes]
+        missing_type = (dt >> 2) & 3
+        default_left = (dt & K_DEFAULT_LEFT_MASK) > 0
+        is_cat = (dt & K_CATEGORICAL_MASK) > 0
+        nan_mask = np.isnan(fvals)
+        # numerical (ref: tree.h:335 NumericalDecision)
+        fv = np.where(nan_mask & (missing_type != MISSING_NAN), 0.0, fvals)
+        is_zero = np.abs(fv) <= K_ZERO_THRESHOLD
+        take_default = (((missing_type == MISSING_ZERO) & is_zero)
+                        | ((missing_type == MISSING_NAN) & nan_mask))
+        num_left = np.where(take_default, default_left,
+                            fv <= self.threshold[nodes])
+        if not is_cat.any():
+            return num_left
+        # categorical (ref: tree.h:372 CategoricalDecision)
+        cat_left = np.zeros(len(fvals), dtype=bool)
+        for i in np.nonzero(is_cat)[0]:
+            v = fvals[i]
+            if np.isnan(v) or int(v) < 0:
+                cat_left[i] = False
+                continue
+            cat_idx = int(self.threshold[nodes[i]])
+            cat_left[i] = self._find_in_bitset(
+                self.cat_threshold, self.cat_boundaries, cat_idx, int(v))
+        return np.where(is_cat, cat_left, num_left)
+
+    @staticmethod
+    def _find_in_bitset(bitset: List[int], boundaries: List[int], cat_idx: int,
+                        val: int) -> bool:
+        start, end = boundaries[cat_idx], boundaries[cat_idx + 1]
+        word = val // 32
+        if word >= end - start:
+            return False
+        return (bitset[start + word] >> (val % 32)) & 1 == 1
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.full(X.shape[0], self.leaf_value[0])
+        return self.leaf_value[self.get_leaf_index(X)]
+
+    # ------------------------------------------------------------------
+    def to_string(self, index: int) -> str:
+        """`Tree=N` block, line-compatible with the reference
+        (ref: tree.cpp:339 ToString)."""
+        nl = self.num_leaves
+        ni = max(nl - 1, 0)
+        lines = [f"Tree={index}",
+                 f"num_leaves={nl}",
+                 f"num_cat={self.num_cat}"]
+
+        def arr(name, a, count, high=False):
+            lines.append(name + "=" + " ".join(_fmt(x, high) for x in a[:count]))
+
+        def iarr(name, a, count):
+            lines.append(name + "=" + " ".join(str(int(x)) for x in a[:count]))
+
+        iarr("split_feature", self.split_feature, ni)
+        arr("split_gain", self.split_gain, ni)
+        arr("threshold", self.threshold, ni, high=True)
+        iarr("decision_type", self.decision_type, ni)
+        iarr("left_child", self.left_child, ni)
+        iarr("right_child", self.right_child, ni)
+        arr("leaf_value", self.leaf_value, nl, high=True)
+        arr("leaf_weight", self.leaf_weight, nl, high=True)
+        iarr("leaf_count", self.leaf_count, nl)
+        arr("internal_value", self.internal_value, ni)
+        arr("internal_weight", self.internal_weight, ni)
+        iarr("internal_count", self.internal_count, ni)
+        if self.num_cat > 0:
+            iarr("cat_boundaries", np.array(self.cat_boundaries), self.num_cat + 1)
+            iarr("cat_threshold", np.array(self.cat_threshold), len(self.cat_threshold))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        lines.append(f"shrinkage={_fmt(self.shrinkage)}")
+        lines.append("")
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        """Parse a `Tree=N` block (ref: tree.cpp Tree::Tree(const char*, size_t*))."""
+        kv: Dict[str, str] = {}
+        for line in text.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        nl = int(kv["num_leaves"])
+        t = cls(max(nl, 2))
+        t.num_leaves = nl
+        t.num_cat = int(kv.get("num_cat", "0"))
+        ni = max(nl - 1, 0)
+
+        def read_arr(key, dtype, count):
+            if count == 0 or key not in kv or kv[key] == "":
+                return np.zeros(count, dtype=dtype)
+            vals = np.array([float(x) for x in kv[key].split()], dtype=np.float64)
+            return vals.astype(dtype)
+
+        if ni > 0:
+            t.split_feature[:ni] = read_arr("split_feature", np.int32, ni)
+            t.split_feature_inner[:ni] = t.split_feature[:ni]
+            t.split_gain[:ni] = read_arr("split_gain", np.float32, ni)
+            t.threshold[:ni] = read_arr("threshold", np.float64, ni)
+            t.decision_type[:ni] = read_arr("decision_type", np.int8, ni)
+            t.left_child[:ni] = read_arr("left_child", np.int32, ni)
+            t.right_child[:ni] = read_arr("right_child", np.int32, ni)
+            t.internal_value[:ni] = read_arr("internal_value", np.float64, ni)
+            t.internal_weight[:ni] = read_arr("internal_weight", np.float64, ni)
+            t.internal_count[:ni] = read_arr("internal_count", np.int64, ni)
+        t.leaf_value[:nl] = read_arr("leaf_value", np.float64, nl)
+        t.leaf_weight[:nl] = read_arr("leaf_weight", np.float64, nl)
+        t.leaf_count[:nl] = read_arr("leaf_count", np.int64, nl)
+        if t.num_cat > 0:
+            t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
+            t.cat_threshold = [int(float(x)) for x in kv["cat_threshold"].split()]
+            t.cat_boundaries_inner = list(t.cat_boundaries)
+            t.cat_threshold_inner = list(t.cat_threshold)
+        t.shrinkage = float(kv.get("shrinkage", "1"))
+        t.is_linear = bool(int(kv.get("is_linear", "0")))
+        return t
+
+    def to_json(self, index: int) -> dict:
+        """(ref: tree.cpp Tree::ToJSON/NodeToJSON)."""
+        def node_json(i: int) -> dict:
+            if i < 0:
+                leaf = ~i
+                return {"leaf_index": leaf,
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_weight": float(self.leaf_weight[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            dt = int(self.decision_type[i])
+            is_cat = bool(dt & K_CATEGORICAL_MASK)
+            mt = {MISSING_NONE: "None", MISSING_ZERO: "Zero",
+                  MISSING_NAN: "NaN"}[(dt >> 2) & 3]
+            return {
+                "split_index": int(i),
+                "split_feature": int(self.split_feature[i]),
+                "split_gain": float(self.split_gain[i]),
+                "threshold": (float(self.threshold[i]) if not is_cat else
+                              "||".join(str(c) for c in self._cats_of_node(i))),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
+                "missing_type": mt,
+                "internal_value": float(self.internal_value[i]),
+                "internal_weight": float(self.internal_weight[i]),
+                "internal_count": int(self.internal_count[i]),
+                "left_child": node_json(int(self.left_child[i])),
+                "right_child": node_json(int(self.right_child[i])),
+            }
+        return {"tree_index": index, "num_leaves": int(self.num_leaves),
+                "num_cat": int(self.num_cat), "shrinkage": float(self.shrinkage),
+                "tree_structure": node_json(0 if self.num_leaves > 1 else ~0)}
+
+    def _cats_of_node(self, node: int) -> List[int]:
+        cat_idx = int(self.threshold[node])
+        start, end = self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1]
+        out = []
+        for w in range(start, end):
+            word = self.cat_threshold[w]
+            for b in range(32):
+                if (word >> b) & 1:
+                    out.append((w - start) * 32 + b)
+        return out
+
+    # ------------------------------------------------------------------
+    def feature_importance_split(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features, dtype=np.float64)
+        for i in range(self.num_leaves - 1):
+            if self.split_gain[i] > 0:
+                out[self.split_feature[i]] += 1
+        return out
+
+    def feature_importance_gain(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features, dtype=np.float64)
+        for i in range(self.num_leaves - 1):
+            if self.split_gain[i] > 0:
+                out[self.split_feature[i]] += self.split_gain[i]
+        return out
+
+
+def _clip_leaf(v: float) -> float:
+    if math.isnan(v):
+        return 0.0
+    return min(max(v, -_K_MAX_VAL), _K_MAX_VAL)
+
+
+def _to_bitset(vals: List[int]) -> List[int]:
+    """(ref: utils/common.h ConstructBitset)."""
+    if not vals:
+        return [0]
+    nwords = max(v for v in vals) // 32 + 1
+    words = [0] * nwords
+    for v in vals:
+        words[v // 32] |= 1 << (v % 32)
+    return words

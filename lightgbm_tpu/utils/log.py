@@ -1,0 +1,58 @@
+"""Leveled logger mirroring the reference's Log (ref: include/LightGBM/utils/log.h:78-135).
+
+Fatal raises, Warning/Info/Debug print with level gating, and an optional callback can
+redirect output (ref: c_api.h LGBM_RegisterLogCallback).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+
+class LightGBMError(RuntimeError):
+    """Raised by Log.fatal (ref: utils/log.h Fatal -> std::runtime_error)."""
+
+
+class _LogState:
+    # -1: fatal only, 0: +warning, 1: +info, 2+: +debug (ref: config.h `verbosity`)
+    level: int = 1
+    callback: Optional[Callable[[str], None]] = None
+
+
+def set_verbosity(level: int) -> None:
+    _LogState.level = level
+
+
+def get_verbosity() -> int:
+    return _LogState.level
+
+
+def register_callback(callback: Optional[Callable[[str], None]]) -> None:
+    _LogState.callback = callback
+
+
+def _emit(msg: str) -> None:
+    if _LogState.callback is not None:
+        _LogState.callback(msg + "\n")
+    else:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def debug(msg: str, *args) -> None:
+    if _LogState.level >= 2:
+        _emit("[LightGBM-TPU] [Debug] " + (msg % args if args else msg))
+
+
+def info(msg: str, *args) -> None:
+    if _LogState.level >= 1:
+        _emit("[LightGBM-TPU] [Info] " + (msg % args if args else msg))
+
+
+def warning(msg: str, *args) -> None:
+    if _LogState.level >= 0:
+        _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
+
+
+def fatal(msg: str, *args) -> None:
+    raise LightGBMError(msg % args if args else msg)

@@ -1,0 +1,9 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh so sharding/collective code
+paths are exercised without TPU hardware (multi-chip dry-run model)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,101 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                                     MISSING_ZERO, BinMapper, greedy_find_bin)
+
+
+def test_greedy_few_distinct_values():
+    bounds = greedy_find_bin([1.0, 2.0, 3.0], [10, 10, 10], max_bin=255,
+                             total_cnt=30, min_data_in_bin=3)
+    # boundaries at midpoints, last is +inf
+    assert bounds[-1] == np.inf
+    assert len(bounds) == 3
+    assert 1.0 < bounds[0] <= 1.5000001
+    assert 2.0 < bounds[1] <= 2.5000001
+
+
+def test_greedy_respects_min_data_in_bin():
+    bounds = greedy_find_bin([1.0, 2.0, 3.0, 4.0], [1, 1, 1, 27], max_bin=255,
+                             total_cnt=30, min_data_in_bin=3)
+    # first three values get merged until >= 3 samples
+    assert len(bounds) == 2
+
+
+def test_find_bin_basic_roundtrip():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=1000)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=16)
+    assert m.num_bin <= 16
+    assert not m.is_trivial
+    bins = m.values_to_bins(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # mapping must be monotone in value
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+
+
+def test_zero_bin_dedicated():
+    # mostly zeros with some positives: zero must get its own bin
+    vals = np.array([1.0, 2.0, 3.0] * 10)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=16)  # 970 implied zeros
+    zero_bin = m.value_to_bin(0.0)
+    pos_bin = m.value_to_bin(1.0)
+    assert zero_bin != pos_bin
+    assert m.default_bin == zero_bin
+
+
+def test_missing_nan_gets_last_bin():
+    vals = np.concatenate([np.arange(100, dtype=float), [np.nan] * 50])
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=150, max_bin=16, use_missing=True)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+
+
+def test_missing_zero_maps_nan_to_zero_bin():
+    vals = np.arange(1, 101, dtype=float)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=200, max_bin=16, zero_as_missing=True)
+    assert m.missing_type in (MISSING_ZERO, MISSING_NONE)
+    assert m.value_to_bin(np.nan) == m.value_to_bin(0.0)
+
+
+def test_trivial_feature():
+    m = BinMapper()
+    m.find_bin(np.array([]), total_sample_cnt=100, max_bin=16)
+    assert m.is_trivial
+
+
+def test_categorical_binning():
+    vals = np.array([0.0] * 5 + [1.0] * 50 + [2.0] * 30 + [3.0] * 15)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=10, bin_type=BIN_CATEGORICAL,
+               min_data_in_bin=1)
+    assert m.bin_type == BIN_CATEGORICAL
+    # most frequent category gets bin 1 (bin 0 reserved for NaN/other)
+    assert m.value_to_bin(1.0) == 1
+    assert m.value_to_bin(2.0) == 2
+    assert m.value_to_bin(np.nan) == 0
+    assert m.value_to_bin(99.0) == 0  # unseen category
+
+
+def test_bin_upper_bounds_are_sorted():
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([rng.normal(size=500), -rng.exponential(size=200)])
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=800, max_bin=32)
+    b = m.bin_upper_bound
+    finite = b[np.isfinite(b)]
+    assert (np.diff(finite) > 0).all()
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(1)
+    m = BinMapper()
+    m.find_bin(rng.normal(size=300), total_sample_cnt=300, max_bin=24)
+    m2 = BinMapper.from_dict(m.to_dict())
+    vals = rng.normal(size=100)
+    assert (m.values_to_bins(vals) == m2.values_to_bins(vals)).all()

@@ -1,0 +1,66 @@
+import pytest
+
+from lightgbm_tpu.config import Config, alias_table, kv2map, read_config_file
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.num_iterations == 100
+    assert cfg.learning_rate == 0.1
+    assert cfg.num_leaves == 31
+    assert cfg.max_bin == 255
+    assert cfg.objective == "regression"
+
+
+def test_aliases_normalize():
+    cfg = Config({"n_estimators": 7, "eta": 0.3, "min_child_samples": 5,
+                  "reg_lambda": 1.5, "subsample": 0.8})
+    assert cfg.num_iterations == 7
+    assert cfg.learning_rate == 0.3
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.lambda_l2 == 1.5
+    assert cfg.bagging_fraction == 0.8
+
+
+def test_alias_table_contains_reference_aliases():
+    at = alias_table()
+    assert at["num_boost_round"] == "num_iterations"
+    assert at["shrinkage_rate"] == "learning_rate"
+    assert at["query"] == "group_column"
+    assert at["unbalanced_sets"] == "is_unbalance"
+
+
+def test_kv_strings_first_wins():
+    m = kv2map(["a=1", "a=2", "b=3"])
+    assert m == {"a": "1", "b": "3"}
+
+
+def test_objective_normalization():
+    assert Config({"objective": "mse"}).objective == "regression"
+    assert Config({"objective": "mae"}).objective == "regression_l1"
+    assert Config({"objective": "softmax", "num_class": 3}).objective == "multiclass"
+    assert Config({"objective": "xendcg"}).objective == "rank_xendcg"
+
+
+def test_boosting_goss_alias():
+    cfg = Config({"boosting": "goss"})
+    assert cfg.boosting == "gbdt"
+    assert cfg.data_sample_strategy == "goss"
+
+
+def test_type_coercion_from_strings():
+    cfg = Config(["num_leaves=63", "learning_rate=0.05", "feature_fraction=0.9",
+                  "is_unbalance=true"])
+    assert cfg.num_leaves == 63
+    assert cfg.learning_rate == 0.05
+    assert cfg.is_unbalance is True
+
+
+def test_config_file_parsing(tmp_path):
+    p = tmp_path / "train.conf"
+    p.write_text("task = train\nobjective = binary\n# comment\nnum_trees = 12\n")
+    m = read_config_file(str(p))
+    cfg = Config(m)
+    assert cfg.task == "train"
+    assert cfg.objective == "binary"
+    assert cfg.num_iterations == 12

@@ -1,0 +1,75 @@
+import numpy as np
+
+from lightgbm_tpu.io.binning import MISSING_NAN, MISSING_NONE
+from lightgbm_tpu.models.tree import Tree
+
+
+def _build_simple_tree():
+    # root split on f0 <= 0.5; left leaf -1.0; right split on f1 <= 2.0 -> (2.0, 3.0)
+    t = Tree(max_leaves=4)
+    t.split(leaf=0, inner_feature=0, real_feature=0, threshold_bin=1,
+            threshold_double=0.5, left_value=-1.0, right_value=1.0,
+            left_cnt=10, right_cnt=20, left_weight=10.0, right_weight=20.0,
+            gain=5.0, missing_type=MISSING_NONE, default_left=False)
+    t.split(leaf=1, inner_feature=1, real_feature=1, threshold_bin=3,
+            threshold_double=2.0, left_value=2.0, right_value=3.0,
+            left_cnt=12, right_cnt=8, left_weight=12.0, right_weight=8.0,
+            gain=3.0, missing_type=MISSING_NONE, default_left=False)
+    return t
+
+
+def test_predict_simple():
+    t = _build_simple_tree()
+    X = np.array([[0.0, 0.0], [1.0, 1.0], [1.0, 3.0]])
+    np.testing.assert_allclose(t.predict(X), [-1.0, 2.0, 3.0])
+
+
+def test_leaf_index():
+    t = _build_simple_tree()
+    X = np.array([[0.0, 0.0], [1.0, 1.0], [1.0, 3.0]])
+    assert list(t.get_leaf_index(X)) == [0, 1, 2]
+
+
+def test_missing_default_direction():
+    t = Tree(max_leaves=2)
+    t.split(leaf=0, inner_feature=0, real_feature=0, threshold_bin=1,
+            threshold_double=0.5, left_value=-1.0, right_value=1.0,
+            left_cnt=1, right_cnt=1, left_weight=1.0, right_weight=1.0,
+            gain=1.0, missing_type=MISSING_NAN, default_left=True)
+    X = np.array([[np.nan], [0.0], [1.0]])
+    np.testing.assert_allclose(t.predict(X), [-1.0, -1.0, 1.0])
+
+
+def test_shrinkage():
+    t = _build_simple_tree()
+    t.apply_shrinkage(0.1)
+    X = np.array([[0.0, 0.0]])
+    np.testing.assert_allclose(t.predict(X), [-0.1])
+
+
+def test_text_roundtrip():
+    t = _build_simple_tree()
+    text = t.to_string(0)
+    assert text.startswith("Tree=0\n")
+    t2 = Tree.from_string(text)
+    X = np.random.RandomState(0).normal(size=(50, 2))
+    np.testing.assert_allclose(t.predict(X), t2.predict(X))
+    assert t2.num_leaves == 3
+
+
+def test_categorical_split_predict():
+    t = Tree(max_leaves=2)
+    t.split_categorical(leaf=0, inner_feature=0, real_feature=0,
+                        bins_in_left=[1, 3], cats_in_left=[2, 5],
+                        left_value=1.0, right_value=-1.0, left_cnt=5, right_cnt=5,
+                        left_weight=5.0, right_weight=5.0, gain=2.0,
+                        missing_type=MISSING_NAN)
+    X = np.array([[2.0], [5.0], [3.0], [np.nan], [-1.0]])
+    np.testing.assert_allclose(t.predict(X), [1.0, 1.0, -1.0, -1.0, -1.0])
+
+
+def test_json():
+    t = _build_simple_tree()
+    j = t.to_json(0)
+    assert j["num_leaves"] == 3
+    assert j["tree_structure"]["split_feature"] == 0
