@@ -1,0 +1,10 @@
+"""Tree learners: jitted whole-tree growth on TPU.
+
+Replaces the reference's src/treelearner/ (SerialTreeLearner + CUDA single-GPU
+learner): the per-leaf loop runs inside one XLA program (lax.fori_loop) instead
+of a host-driven kernel-launch loop, per SURVEY.md §3.3's TPU lesson.
+"""
+
+from .grow import FeatureMeta, GrowParams, TreeArrays, grow_tree, make_grow_tree
+
+__all__ = ["FeatureMeta", "GrowParams", "TreeArrays", "grow_tree", "make_grow_tree"]

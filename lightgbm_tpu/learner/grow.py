@@ -1,0 +1,318 @@
+"""Leaf-wise (best-first) tree growth as ONE jitted XLA program.
+
+TPU-native re-design of SerialTreeLearner::Train
+(ref: src/treelearner/serial_tree_learner.cpp:179-240) and the CUDA learner's
+host-driven per-leaf loop (ref: src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:155-245).
+Design differences from the reference, chosen for the TPU compilation model:
+
+* The whole num_leaves-1 split loop is a `lax.fori_loop` inside one jit — no
+  per-split host round trip (the CUDA learner pays a D2H sync per split;
+  SURVEY.md §3.3 flags this as the thing to avoid on TPU).
+* Row partition is a leaf-id recoloring array `leaf_id[n]` with fixed shape,
+  not per-leaf index lists (ref: data_partition.hpp keeps ragged index lists —
+  ragged shapes don't jit).
+* Histogram bookkeeping keeps the reference's smaller-child trick: the smaller
+  child's histogram is built fresh, the larger's is parent − smaller
+  (ref: serial_tree_learner.cpp:334 BeforeFindBestSplit, feature_histogram.hpp
+  Subtract).  A per-leaf histogram stack [L, F, B, 2] plays the role of the
+  HistogramPool (ref: feature_histogram.hpp:1367); when it would not fit in
+  HBM, `use_hist_stack=False` rebuilds both children instead.
+* Bagging is a row mask multiplied into grad/hess (no subset copy);
+  feature_fraction is a column mask into the gain scan.
+
+All reductions over the row axis (histograms, sums, counts) are the only ops
+touching sharded data, so the same program runs data-parallel under pjit with
+rows sharded over a mesh — XLA inserts the psum that replaces
+Network::ReduceScatter (ref: data_parallel_tree_learner.cpp:284).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import build_histogram
+from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult, find_best_split,
+                         MISSING_NAN, MISSING_ZERO)
+
+
+class FeatureMeta(NamedTuple):
+    """Per-feature bin metadata, device-resident (ref: FeatureMetainfo,
+    feature_histogram.hpp:40)."""
+    num_bin: jnp.ndarray        # [F] int32
+    missing_type: jnp.ndarray   # [F] int32
+    default_bin: jnp.ndarray    # [F] int32
+    penalty: jnp.ndarray        # [F] float32 (feature_contri)
+
+
+class GrowParams(NamedTuple):
+    """Static growth hyperparameters."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    max_bin: int = 255
+    split: SplitParams = SplitParams()
+    use_hist_stack: bool = True
+    hist_method: str = "segment"
+
+
+class TreeArrays(NamedTuple):
+    """Device-side grown tree (mirrors Tree's parallel arrays, ref: tree.h:25)."""
+    num_leaves: jnp.ndarray       # scalar int32
+    split_feature: jnp.ndarray    # [L-1] int32 (inner feature index)
+    threshold_bin: jnp.ndarray    # [L-1] int32
+    default_left: jnp.ndarray     # [L-1] bool
+    split_gain: jnp.ndarray       # [L-1] float32
+    left_child: jnp.ndarray       # [L-1] int32 (~leaf encoding)
+    right_child: jnp.ndarray      # [L-1] int32
+    internal_value: jnp.ndarray   # [L-1] float32
+    internal_weight: jnp.ndarray  # [L-1] float32
+    internal_count: jnp.ndarray   # [L-1] int32
+    leaf_value: jnp.ndarray       # [L] float32
+    leaf_weight: jnp.ndarray      # [L] float32
+    leaf_count: jnp.ndarray       # [L] int32
+    leaf_parent: jnp.ndarray      # [L] int32
+    leaf_depth: jnp.ndarray       # [L] int32
+
+
+class _PendingSplits(NamedTuple):
+    """Best pending split per leaf (ref: best_split_per_leaf_,
+    serial_tree_learner.h:172)."""
+    gain: jnp.ndarray           # [L]
+    feature: jnp.ndarray        # [L] int32
+    threshold: jnp.ndarray      # [L] int32
+    default_left: jnp.ndarray   # [L] bool
+    left_sum_gradient: jnp.ndarray
+    left_sum_hessian: jnp.ndarray
+    left_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_sum_gradient: jnp.ndarray
+    right_sum_hessian: jnp.ndarray
+    right_count: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+class _State(NamedTuple):
+    tree: TreeArrays
+    pending: _PendingSplits
+    leaf_id: jnp.ndarray
+    hist_stack: jnp.ndarray     # [L, F, B, 2] (or [1,1,1,2] dummy)
+    leaf_sum_g: jnp.ndarray     # [L]
+    leaf_sum_h: jnp.ndarray     # [L]
+    done: jnp.ndarray           # scalar bool
+
+
+def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
+    return _PendingSplits(
+        gain=p.gain.at[idx].set(res.gain),
+        feature=p.feature.at[idx].set(res.feature),
+        threshold=p.threshold.at[idx].set(res.threshold),
+        default_left=p.default_left.at[idx].set(res.default_left),
+        left_sum_gradient=p.left_sum_gradient.at[idx].set(res.left_sum_gradient),
+        left_sum_hessian=p.left_sum_hessian.at[idx].set(res.left_sum_hessian),
+        left_count=p.left_count.at[idx].set(res.left_count),
+        left_output=p.left_output.at[idx].set(res.left_output),
+        right_sum_gradient=p.right_sum_gradient.at[idx].set(res.right_sum_gradient),
+        right_sum_hessian=p.right_sum_hessian.at[idx].set(res.right_sum_hessian),
+        right_count=p.right_count.at[idx].set(res.right_count),
+        right_output=p.right_output.at[idx].set(res.right_output))
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              row_mask: jnp.ndarray, col_mask: jnp.ndarray, meta: FeatureMeta,
+              params: GrowParams):
+    """Grow one leaf-wise tree.
+
+    Args:
+      binned: [F, n] int bin codes (n may include padded rows with row_mask=0).
+      grad/hess: [n] float32 gradients/hessians.
+      row_mask: [n] float32 0/1 (bagging x padding mask).
+      col_mask: [F] bool (feature_fraction sampling).
+      meta: per-feature bin metadata.
+      params: static GrowParams.
+
+    Returns: (TreeArrays, leaf_id [n] int32)
+    """
+    num_features, n = binned.shape
+    L = params.num_leaves
+    B = params.max_bin
+    sp = params.split
+    f32 = jnp.float32
+
+    grad = grad.astype(f32) * row_mask.astype(f32)
+    hess = hess.astype(f32) * row_mask.astype(f32)
+    gh = jnp.stack([grad, hess], axis=1)
+    ones_mask = jnp.ones((n,), dtype=f32)  # grad/hess already carry row_mask
+
+    def hist_of(member_mask):
+        return build_histogram(binned, gh, member_mask, max_bin=B,
+                               method=params.hist_method)
+
+    def best_of(hist, sum_g, sum_h, cnt, parent_out):
+        return find_best_split(hist, meta.num_bin, meta.missing_type,
+                               meta.default_bin, meta.penalty, col_mask,
+                               sum_g, sum_h, cnt, parent_out, sp)
+
+    # ---- root (ref: serial_tree_learner BeforeTrain + root leaf splits) ----
+    sum_g0 = jnp.sum(grad)
+    sum_h0 = jnp.sum(hess)
+    cnt0 = jnp.sum(row_mask.astype(jnp.int32))
+    root_hist = hist_of(ones_mask)
+    root_best = best_of(root_hist, sum_g0, sum_h0, cnt0, jnp.asarray(0.0, f32))
+
+    ni = max(L - 1, 1)
+    tree = TreeArrays(
+        num_leaves=jnp.asarray(1, jnp.int32),
+        split_feature=jnp.zeros(ni, jnp.int32),
+        threshold_bin=jnp.zeros(ni, jnp.int32),
+        default_left=jnp.zeros(ni, bool),
+        split_gain=jnp.zeros(ni, f32),
+        left_child=jnp.zeros(ni, jnp.int32),
+        right_child=jnp.zeros(ni, jnp.int32),
+        internal_value=jnp.zeros(ni, f32),
+        internal_weight=jnp.zeros(ni, f32),
+        internal_count=jnp.zeros(ni, jnp.int32),
+        leaf_value=jnp.zeros(L, f32),
+        leaf_weight=jnp.zeros(L, f32).at[0].set(sum_h0),
+        leaf_count=jnp.zeros(L, jnp.int32).at[0].set(cnt0),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_depth=jnp.zeros(L, jnp.int32))
+    pending = _PendingSplits(
+        gain=jnp.full(L, K_MIN_SCORE, f32),
+        feature=jnp.zeros(L, jnp.int32), threshold=jnp.zeros(L, jnp.int32),
+        default_left=jnp.zeros(L, bool),
+        left_sum_gradient=jnp.zeros(L, f32), left_sum_hessian=jnp.zeros(L, f32),
+        left_count=jnp.zeros(L, jnp.int32), left_output=jnp.zeros(L, f32),
+        right_sum_gradient=jnp.zeros(L, f32), right_sum_hessian=jnp.zeros(L, f32),
+        right_count=jnp.zeros(L, jnp.int32), right_output=jnp.zeros(L, f32))
+    pending = _pending_set(pending, 0, root_best)
+
+    if params.use_hist_stack:
+        hist_stack = jnp.zeros((L, num_features, B, 2), f32).at[0].set(root_hist)
+    else:
+        hist_stack = jnp.zeros((1, 1, 1, 2), f32)
+
+    state = _State(tree=tree, pending=pending,
+                   leaf_id=jnp.zeros(n, jnp.int32), hist_stack=hist_stack,
+                   leaf_sum_g=jnp.zeros(L, f32).at[0].set(sum_g0),
+                   leaf_sum_h=jnp.zeros(L, f32).at[0].set(sum_h0),
+                   done=jnp.asarray(False))
+
+    def body(i, st: _State):
+        # leaf selection (ref: serial_tree_learner.cpp:219 ArgMax over leaves);
+        # max_depth gates children depth (ref: serial_tree_learner BeforeFindBestSplit)
+        sel_gain = st.pending.gain
+        if params.max_depth > 0:
+            sel_gain = jnp.where(st.tree.leaf_depth < params.max_depth,
+                                 sel_gain, K_MIN_SCORE)
+        best_leaf = jnp.argmax(sel_gain).astype(jnp.int32)
+        proceed = jnp.logical_and(~st.done, sel_gain[best_leaf] > 0.0)
+
+        def do_split(st: _State) -> _State:
+            node = i                      # node index == step (num_leaves-1)
+            new_leaf = i + 1              # new right-child leaf index
+            pd = st.pending
+            feat = pd.feature[best_leaf]
+            thr = pd.threshold[best_leaf]
+            dleft = pd.default_left[best_leaf]
+
+            # --- partition by recoloring (ref: dense_bin.hpp:346-366 SplitInner) ---
+            fbins = jnp.take(binned, feat, axis=0).astype(jnp.int32)
+            mt_f = meta.missing_type[feat]
+            is_missing = (((mt_f == MISSING_NAN) & (fbins == meta.num_bin[feat] - 1))
+                          | ((mt_f == MISSING_ZERO) & (fbins == meta.default_bin[feat])))
+            go_left = jnp.where(is_missing, dleft, fbins <= thr)
+            in_leaf = st.leaf_id == best_leaf
+            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
+
+            # actual per-child counts (ref: DataPartition gives actual counts)
+            lmaskf = (in_leaf & go_left).astype(f32) * row_mask.astype(f32)
+            rmaskf = (in_leaf & ~go_left).astype(f32) * row_mask.astype(f32)
+            cnt_l = jnp.sum(lmaskf).astype(jnp.int32)
+            cnt_r = jnp.sum(rmaskf).astype(jnp.int32)
+
+            # --- tree arrays (ref: tree.cpp Tree::Split) ---
+            t = st.tree
+            parent = t.leaf_parent[best_leaf]
+            # fix the parent's child pointer that referenced ~best_leaf
+            lc = jnp.where((parent >= 0) & (t.left_child[parent] == ~best_leaf),
+                           node, t.left_child[parent])
+            rc = jnp.where((parent >= 0) & (t.left_child[parent] != ~best_leaf),
+                           node, t.right_child[parent])
+            left_child = t.left_child.at[parent].set(
+                jnp.where(parent >= 0, lc, t.left_child[parent]))
+            right_child = t.right_child.at[parent].set(
+                jnp.where(parent >= 0, rc, t.right_child[parent]))
+            depth = t.leaf_depth[best_leaf] + 1
+            tree = TreeArrays(
+                num_leaves=t.num_leaves + 1,
+                split_feature=t.split_feature.at[node].set(feat),
+                threshold_bin=t.threshold_bin.at[node].set(thr),
+                default_left=t.default_left.at[node].set(dleft),
+                split_gain=t.split_gain.at[node].set(pd.gain[best_leaf]),
+                left_child=left_child.at[node].set(~best_leaf),
+                right_child=right_child.at[node].set(~new_leaf),
+                internal_value=t.internal_value.at[node].set(t.leaf_value[best_leaf]),
+                internal_weight=t.internal_weight.at[node].set(
+                    pd.left_sum_hessian[best_leaf] + pd.right_sum_hessian[best_leaf]),
+                internal_count=t.internal_count.at[node].set(cnt_l + cnt_r),
+                leaf_value=t.leaf_value.at[best_leaf].set(pd.left_output[best_leaf])
+                                       .at[new_leaf].set(pd.right_output[best_leaf]),
+                leaf_weight=t.leaf_weight.at[best_leaf].set(pd.left_sum_hessian[best_leaf])
+                                         .at[new_leaf].set(pd.right_sum_hessian[best_leaf]),
+                leaf_count=t.leaf_count.at[best_leaf].set(cnt_l)
+                                       .at[new_leaf].set(cnt_r),
+                leaf_parent=t.leaf_parent.at[best_leaf].set(node)
+                                         .at[new_leaf].set(node),
+                leaf_depth=t.leaf_depth.at[best_leaf].set(depth)
+                                       .at[new_leaf].set(depth))
+
+            # --- child histograms: smaller fresh, larger by subtraction
+            # (ref: serial_tree_learner.cpp histogram subtraction) ---
+            lsum_g, lsum_h = pd.left_sum_gradient[best_leaf], pd.left_sum_hessian[best_leaf]
+            rsum_g, rsum_h = pd.right_sum_gradient[best_leaf], pd.right_sum_hessian[best_leaf]
+            smaller_is_left = cnt_l <= cnt_r
+            if params.use_hist_stack:
+                small_mask = jnp.where(smaller_is_left, lmaskf, rmaskf)
+                small_hist = hist_of(small_mask)
+                parent_hist = st.hist_stack[best_leaf]
+                large_hist = parent_hist - small_hist
+                hist_l = jnp.where(smaller_is_left, small_hist, large_hist)
+                hist_r = jnp.where(smaller_is_left, large_hist, small_hist)
+                hist_stack = (st.hist_stack.at[best_leaf].set(hist_l)
+                              .at[new_leaf].set(hist_r))
+            else:
+                hist_l = hist_of(lmaskf)
+                hist_r = hist_of(rmaskf)
+                hist_stack = st.hist_stack
+
+            best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
+                             pd.left_output[best_leaf])
+            best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
+                             pd.right_output[best_leaf])
+            pending = _pending_set(_pending_set(pd, best_leaf, best_l),
+                                   new_leaf, best_r)
+            return _State(tree=tree, pending=pending, leaf_id=leaf_id,
+                          hist_stack=hist_stack,
+                          leaf_sum_g=st.leaf_sum_g.at[best_leaf].set(lsum_g)
+                                                  .at[new_leaf].set(rsum_g),
+                          leaf_sum_h=st.leaf_sum_h.at[best_leaf].set(lsum_h)
+                                                  .at[new_leaf].set(rsum_h),
+                          done=st.done)
+
+        return jax.lax.cond(proceed, do_split,
+                            lambda s: s._replace(done=jnp.asarray(True)), st)
+
+    if L > 1:
+        state = jax.lax.fori_loop(0, L - 1, body, state)
+    return state.tree, state.leaf_id
+
+
+def make_grow_tree(params: GrowParams):
+    """Partial application helper so callers hold one traced function."""
+    def fn(binned, grad, hess, row_mask, col_mask, meta):
+        return grow_tree(binned, grad, hess, row_mask, col_mask, meta, params)
+    return fn

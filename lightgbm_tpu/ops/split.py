@@ -1,0 +1,224 @@
+"""Best-split finding: the reference's sequential per-bin gain scan, vectorized.
+
+TPU-native replacement for FeatureHistogram::FindBestThresholdSequentially
+(ref: src/treelearner/feature_histogram.hpp:831-1057) and the CUDA kernels
+FindBestSplitsForLeafKernel / SyncBestSplitForLeafKernel
+(ref: src/treelearner/cuda/cuda_best_split_finder.cu:772,1920): instead of a
+serial loop per feature, both scan directions are evaluated for ALL features
+and ALL candidate thresholds at once via masked prefix/suffix cumsums, then a
+single argmax picks the winner — the shape XLA tiles well.
+
+Behavioral parity notes (each mirrors a reference line):
+  * counts are derived from hessians: cnt(bin) = RoundInt(hess * cnt_factor),
+    cnt_factor = num_data / sum_hessian (feature_histogram.hpp:871-874).
+  * accumulators are seeded with kEpsilon=1e-15 and the leaf hessian carries
+    +2*kEpsilon (feature_histogram.hpp:169-171, 856, 941).
+  * REVERSE scan (default_left=True) excludes the NaN bin so missing joins the
+    left side; the forward scan leaves it on the right (hpp:859-867, 946-963).
+  * MissingType::Zero skips the zero ("default") bin in both scans, so the zero
+    bin always follows default_left (hpp:865-869 SKIP_DEFAULT_BIN).
+  * `break` conditions (left side runs out of data/hessian) are monotone in the
+    threshold, so masking is exactly equivalent to breaking.
+  * within a scan, ties keep the first-visited threshold: largest for REVERSE,
+    smallest for forward; the forward result replaces the reverse one only on
+    strictly larger gain (hpp:1031).
+  * across features, gain ties pick the smaller feature index
+    (split_info.hpp:138-163 operator>).
+
+The scan works in the "full bin" layout (bins 0..num_bin-1 present for every
+feature, no most_freq_bin offset packing) — equivalent results, simpler tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15  # ref: include/LightGBM/meta.h:54
+K_MIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class SplitParams(NamedTuple):
+    """Static split hyperparameters (subset of ref Config used by the gain scan)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+
+
+class SplitResult(NamedTuple):
+    """Device-side SplitInfo (ref: src/treelearner/split_info.hpp:22)."""
+    gain: jnp.ndarray            # shifted gain (<=0 means no valid split)
+    feature: jnp.ndarray         # inner feature index (int32)
+    threshold: jnp.ndarray       # bin threshold (int32)
+    default_left: jnp.ndarray    # bool
+    left_sum_gradient: jnp.ndarray
+    left_sum_hessian: jnp.ndarray
+    left_count: jnp.ndarray      # int32
+    left_output: jnp.ndarray
+    right_sum_gradient: jnp.ndarray
+    right_sum_hessian: jnp.ndarray
+    right_count: jnp.ndarray     # int32
+    right_output: jnp.ndarray
+
+
+def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
+    """ref: feature_histogram.hpp:710 ThresholdL1."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(sum_g, sum_h, count, parent_output, p: SplitParams):
+    """ref: feature_histogram.hpp:716 CalculateSplittedLeafOutput."""
+    ret = -threshold_l1(sum_g, p.lambda_l1) / (sum_h + p.lambda_l2)
+    if p.max_delta_step > 0:
+        ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
+    if p.path_smooth > K_EPSILON:
+        ratio = count.astype(ret.dtype) / p.path_smooth
+        ret = ret * ratio / (ratio + 1.0) + parent_output / (ratio + 1.0)
+    return ret
+
+
+def leaf_gain(sum_g, sum_h, count, parent_output, p: SplitParams):
+    """ref: feature_histogram.hpp:800 GetLeafGain."""
+    if p.max_delta_step <= 0 and p.path_smooth <= K_EPSILON:
+        sg_l1 = threshold_l1(sum_g, p.lambda_l1)
+        return (sg_l1 * sg_l1) / (sum_h + p.lambda_l2)
+    out = leaf_output(sum_g, sum_h, count, parent_output, p)
+    sg_l1 = threshold_l1(sum_g, p.lambda_l1)
+    return -(2.0 * sg_l1 * out + (sum_h + p.lambda_l2) * out * out)
+
+
+def _round_int(x: jnp.ndarray) -> jnp.ndarray:
+    """ref: utils/common.h RoundInt: static_cast<int>(x + 0.5)."""
+    return jnp.floor(x + 0.5).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
+                    missing_type: jnp.ndarray, default_bin: jnp.ndarray,
+                    feature_penalty: jnp.ndarray, col_mask: jnp.ndarray,
+                    sum_gradient: jnp.ndarray, sum_hessian: jnp.ndarray,
+                    num_data: jnp.ndarray, parent_output: jnp.ndarray,
+                    params: SplitParams) -> SplitResult:
+    """Scan all (feature, threshold, direction) candidates; return the leaf's best.
+
+    Args:
+      hist: [F, B, 2] (sum_gradient, sum_hessian) per bin.
+      num_bin/missing_type/default_bin: [F] int32 per-feature bin metadata.
+      feature_penalty: [F] gain multiplier (ref: meta_->penalty, feature_contri).
+      col_mask: [F] bool, feature_fraction sampling mask.
+      sum_gradient/sum_hessian: leaf totals (hessian WITHOUT the +2eps; added here,
+        ref: feature_histogram.hpp:169 FindBestThreshold).
+      num_data: actual row count in leaf (int32).
+      parent_output: leaf's current output (for path smoothing).
+    """
+    num_features, max_bin, _ = hist.shape
+    f32 = jnp.float32
+    sum_g = sum_gradient.astype(f32)
+    sum_h = sum_hessian.astype(f32) + 2 * K_EPSILON
+    n_leaf = num_data.astype(f32)
+    cnt_factor = n_leaf / sum_h
+
+    bins = jnp.arange(max_bin, dtype=jnp.int32)[None, :]           # [1, B]
+    nb = num_bin[:, None]
+    mt = missing_type[:, None]
+    db = default_bin[:, None]
+    na_extra = (mt == MISSING_NAN).astype(jnp.int32)               # [F, 1]
+
+    in_range = bins < nb
+    is_na_bin = (mt == MISSING_NAN) & (bins == nb - 1)
+    is_def_bin = (mt == MISSING_ZERO) & (bins == db)
+    acc = in_range & ~is_na_bin & ~is_def_bin
+    grad = jnp.where(acc, hist[:, :, 0], 0.0)
+    hess = jnp.where(acc, hist[:, :, 1], 0.0)
+    cnt = jnp.where(acc, _round_int(hist[:, :, 1] * cnt_factor), 0)
+
+    pg = jnp.cumsum(grad, axis=1)
+    ph = jnp.cumsum(hess, axis=1)
+    pc = jnp.cumsum(cnt, axis=1)
+    tg, th, tc = pg[:, -1:], ph[:, -1:], pc[:, -1:]
+
+    min_gain_shift = (leaf_gain(sum_g, sum_h, n_leaf, parent_output, params)
+                      + params.min_gain_to_split)
+
+    def eval_candidates(left_g, left_h_raw, left_c, tau_ok):
+        """Gain for candidates where left side = (left_g, left_h_raw+eps, left_c)."""
+        left_h = left_h_raw + K_EPSILON
+        right_g = sum_g - left_g
+        right_h = sum_h - left_h
+        right_c = num_data - left_c
+        ok = (tau_ok
+              & (left_c >= params.min_data_in_leaf)
+              & (left_h >= params.min_sum_hessian_in_leaf)
+              & (right_c >= params.min_data_in_leaf)
+              & (right_h >= params.min_sum_hessian_in_leaf))
+        gain = (leaf_gain(left_g, left_h, left_c.astype(f32), parent_output, params)
+                + leaf_gain(right_g, right_h, right_c.astype(f32), parent_output,
+                            params))
+        ok = ok & (gain > min_gain_shift)
+        return jnp.where(ok, gain, K_MIN_SCORE)
+
+    # ---- REVERSE scan: left = bins <= tau (+NaN, +zero-bin when default_left) ----
+    # right side accumulates bins > tau; candidate at threshold tau = t-1
+    # (ref: hpp:856-930), so left sums are the inclusive prefix at tau.
+    rev_tau_ok = (bins <= nb - 2 - na_extra) & in_range
+    rev_tau_ok &= ~((mt == MISSING_ZERO) & (bins == db - 1))  # skipped iteration
+    # REVERSE accumulates right_h = kEps + suffix; left_h = sum_h - right_h.
+    # eval_candidates re-adds its own eps to the raw left, so raw subtracts both.
+    rev_left_g = sum_g - (tg - pg)
+    rev_left_h_raw = sum_h - (th - ph) - 2 * K_EPSILON
+    rev_left_c = num_data - (tc - pc)
+    rev_gain = eval_candidates(rev_left_g, rev_left_h_raw, rev_left_c, rev_tau_ok)
+    # tie-break: largest tau wins (scan visits from the right)
+    rev_best_idx = (max_bin - 1
+                    - jnp.argmax(rev_gain[:, ::-1], axis=1)).astype(jnp.int32)
+    rev_best_gain = jnp.take_along_axis(rev_gain, rev_best_idx[:, None], 1)[:, 0]
+
+    # ---- FORWARD scan: left = inclusive prefix at tau; missing goes right ----
+    fwd_tau_ok = (bins <= nb - 2) & in_range & (mt != MISSING_NONE)
+    fwd_tau_ok &= ~((mt == MISSING_ZERO) & (bins == db))      # skipped iteration
+    fwd_gain = eval_candidates(pg, ph, pc, fwd_tau_ok)
+    fwd_best_idx = jnp.argmax(fwd_gain, axis=1).astype(jnp.int32)
+    fwd_best_gain = jnp.take_along_axis(fwd_gain, fwd_best_idx[:, None], 1)[:, 0]
+
+    # forward replaces reverse only on strictly larger gain (ref: hpp:1031)
+    use_fwd = fwd_best_gain > rev_best_gain
+    best_gain_f = jnp.where(use_fwd, fwd_best_gain, rev_best_gain)
+    best_thr_f = jnp.where(use_fwd, fwd_best_idx, rev_best_idx)
+    # per-feature left sums at the winning threshold
+    take = lambda a, idx: jnp.take_along_axis(a, idx[:, None], 1)[:, 0]
+    lg = jnp.where(use_fwd, take(pg, fwd_best_idx), take(rev_left_g, rev_best_idx))
+    lh_raw = jnp.where(use_fwd, take(ph, fwd_best_idx),
+                       take(rev_left_h_raw, rev_best_idx))
+    lc = jnp.where(use_fwd, take(pc, fwd_best_idx), take(rev_left_c, rev_best_idx))
+
+    # feature penalty + column sampling, then pick the best feature
+    # (gain tie -> smaller index, matching SplitInfo::operator>)
+    shifted = (best_gain_f - min_gain_shift) * feature_penalty
+    shifted = jnp.where(col_mask & (best_gain_f > K_MIN_SCORE), shifted, K_MIN_SCORE)
+    best_f = jnp.argmax(shifted, axis=0).astype(jnp.int32)
+
+    g_ = shifted[best_f]
+    lg_, lc_ = lg[best_f], lc[best_f]
+    lh_ = lh_raw[best_f] + K_EPSILON
+    rg_, rc_ = sum_g - lg_, num_data - lc_
+    rh_ = sum_h - lh_
+    left_out = leaf_output(lg_, lh_, lc_.astype(f32), parent_output, params)
+    right_out = leaf_output(rg_, rh_, rc_.astype(f32), parent_output, params)
+    return SplitResult(
+        gain=g_, feature=best_f, threshold=best_thr_f[best_f],
+        default_left=~use_fwd[best_f],
+        left_sum_gradient=lg_, left_sum_hessian=lh_ - K_EPSILON,
+        left_count=lc_, left_output=left_out,
+        right_sum_gradient=rg_, right_sum_hessian=rh_ - K_EPSILON,
+        right_count=rc_, right_output=right_out)

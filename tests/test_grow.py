@@ -1,0 +1,135 @@
+"""Tests for the jitted whole-tree grower (learner/grow.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from lightgbm_tpu.learner import FeatureMeta, GrowParams, grow_tree
+from lightgbm_tpu.ops.split import MISSING_NONE, SplitParams
+
+RNG = np.random.RandomState(3)
+
+
+def _meta(F, B):
+    return FeatureMeta(num_bin=jnp.full(F, B, jnp.int32),
+                       missing_type=jnp.full(F, MISSING_NONE, jnp.int32),
+                       default_bin=jnp.zeros(F, jnp.int32),
+                       penalty=jnp.ones(F, jnp.float32))
+
+
+def _grow(binned, grad, hess, params):
+    F, n = binned.shape
+    return grow_tree(jnp.array(binned), jnp.array(grad), jnp.array(hess),
+                     jnp.ones(n, jnp.float32), jnp.ones(F, bool),
+                     _meta(F, params.max_bin), params)
+
+
+def test_single_split_tree():
+    """One perfectly-separating feature, num_leaves=2."""
+    n = 100
+    binned = np.zeros((1, n), dtype=np.int32)
+    binned[0, n // 2:] = 5
+    grad = np.where(np.arange(n) >= n // 2, 2.0, -2.0).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    params = GrowParams(num_leaves=2, max_bin=8,
+                        split=SplitParams(min_data_in_leaf=1))
+    tree, leaf_id = _grow(binned, grad, hess, params)
+    assert int(tree.num_leaves) == 2
+    assert int(tree.split_feature[0]) == 0
+    assert 0 <= int(tree.threshold_bin[0]) < 5
+    # left leaf (id 0) holds grad=-2 rows -> output +2; right (id 1) -> -2
+    np.testing.assert_allclose(float(tree.leaf_value[0]), 2.0, atol=0.01)
+    np.testing.assert_allclose(float(tree.leaf_value[1]), -2.0, atol=0.01)
+    lid = np.asarray(leaf_id)
+    assert (lid[:n // 2] == 0).all() and (lid[n // 2:] == 1).all()
+    assert int(tree.leaf_count[0]) == n // 2
+    assert int(tree.leaf_count[1]) == n // 2
+
+
+def test_grow_reduces_squared_error():
+    """Leaf outputs on L2 gradients must reduce train MSE monotonically in leaves."""
+    n, F, B = 1024, 4, 32
+    X = RNG.rand(n, F)
+    y = (np.sin(X[:, 0] * 6) + X[:, 1] ** 2 + 0.1 * RNG.randn(n)).astype(np.float32)
+    binned = np.stack([np.clip((X[:, f] * B).astype(np.int32), 0, B - 1)
+                       for f in range(F)]).astype(np.int32)
+    grad = -y  # L2 gradients at score 0 (grad = score - y)
+    hess = np.ones(n, np.float32)
+    prev = np.inf
+    for L in (2, 8, 31):
+        params = GrowParams(num_leaves=L, max_bin=B,
+                            split=SplitParams(min_data_in_leaf=5, lambda_l2=0.0))
+        tree, leaf_id = _grow(binned, grad, hess, params)
+        pred = np.asarray(tree.leaf_value)[np.asarray(leaf_id)]
+        mse = float(np.mean((y - pred) ** 2))
+        assert mse < prev, (L, mse, prev)
+        prev = mse
+    assert prev < float(np.var(y)) * 0.35
+
+
+def test_gain_stopping():
+    """Pure-noise constant gradients: no split has positive gain -> 1 leaf."""
+    n = 256
+    binned = RNG.randint(0, 16, size=(2, n)).astype(np.int32)
+    grad = np.ones(n, np.float32)  # constant -> no variance to explain
+    hess = np.ones(n, np.float32)
+    params = GrowParams(num_leaves=31, max_bin=16,
+                        split=SplitParams(min_data_in_leaf=5, min_gain_to_split=0.0))
+    tree, leaf_id = _grow(binned, grad, hess, params)
+    assert int(tree.num_leaves) == 1
+    assert (np.asarray(leaf_id) == 0).all()
+
+
+def test_max_depth_limits_leaves():
+    n, F, B = 2048, 3, 64
+    X = RNG.rand(n, F)
+    y = (X[:, 0] + X[:, 1] * X[:, 2]).astype(np.float32)
+    binned = np.stack([np.clip((X[:, f] * B).astype(np.int32), 0, B - 1)
+                       for f in range(F)]).astype(np.int32)
+    params = GrowParams(num_leaves=64, max_depth=3, max_bin=B,
+                        split=SplitParams(min_data_in_leaf=1))
+    tree, _ = _grow(binned, -y, np.ones(n, np.float32), params)
+    assert int(tree.num_leaves) <= 8  # 2^3
+    assert int(np.asarray(tree.leaf_depth)[:int(tree.num_leaves)].max()) <= 3
+
+
+def test_row_mask_excludes_rows():
+    """Bagged-out rows must not influence the tree (leaf counts)."""
+    n = 400
+    binned = np.zeros((1, n), dtype=np.int32)
+    binned[0, :200] = 1
+    grad = np.where(np.arange(n) < 200, -1.0, 1.0).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    mask = np.zeros(n, np.float32)
+    mask[:300] = 1.0
+    params = GrowParams(num_leaves=2, max_bin=4,
+                        split=SplitParams(min_data_in_leaf=1))
+    F = 1
+    tree, leaf_id = grow_tree(jnp.array(binned), jnp.array(grad), jnp.array(hess),
+                              jnp.array(mask), jnp.ones(F, bool),
+                              _meta(F, 4), params)
+    assert int(tree.num_leaves) == 2
+    assert int(tree.leaf_count[0]) + int(tree.leaf_count[1]) == 300
+
+
+def test_subtraction_equals_rebuild():
+    """use_hist_stack=True (subtraction) and False (rebuild) give identical trees."""
+    n, F, B = 1024, 5, 32
+    X = RNG.rand(n, F)
+    y = (X[:, 0] * 3 + np.cos(X[:, 2] * 7) + 0.05 * RNG.randn(n)).astype(np.float32)
+    binned = np.stack([np.clip((X[:, f] * B).astype(np.int32), 0, B - 1)
+                       for f in range(F)]).astype(np.int32)
+    grad, hess = -y, np.ones(n, np.float32)
+    t1, l1 = _grow(binned, grad, hess,
+                   GrowParams(num_leaves=16, max_bin=B, use_hist_stack=True,
+                              split=SplitParams(min_data_in_leaf=5)))
+    t2, l2 = _grow(binned, grad, hess,
+                   GrowParams(num_leaves=16, max_bin=B, use_hist_stack=False,
+                              split=SplitParams(min_data_in_leaf=5)))
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t2.split_feature))
+    np.testing.assert_array_equal(np.asarray(t1.threshold_bin),
+                                  np.asarray(t2.threshold_bin))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value), np.asarray(t2.leaf_value),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
