@@ -5,17 +5,30 @@ SURVEY.md): histogram-based leaf-wise GBDT/DART/RF, the full objective/metric su
 LightGBM-compatible model text format and train()/predict() API — with binned features
 resident in TPU HBM, whole-tree growth inside jitted XLA programs, and distributed
 data-parallel training over `jax.sharding.Mesh` ICI/DCN collectives.
+
+Public surface mirrors python-package/lightgbm/__init__.py.
 """
 
 __version__ = "0.1.0"
 
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
 from .config import Config
-from .io.dataset import Dataset as _RawDataset  # internal binned dataset
+from .engine import cv, train
 from .utils.log import LightGBMError, register_callback
 
 __all__ = [
+    "Booster",
     "Config",
+    "Dataset",
+    "EarlyStopException",
     "LightGBMError",
+    "cv",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
     "register_callback",
+    "train",
     "__version__",
 ]

@@ -1,0 +1,318 @@
+"""User-facing Dataset and Booster (ref: python-package/lightgbm/basic.py).
+
+The reference's basic.py talks to the C++ core over ctypes (LGBM_* C API); here
+the "core" is the in-process TPU engine, so these classes wrap
+io.dataset.Dataset and boosting.GBDT directly with the same surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import Dataset as _CoreDataset, load_dataset_from_file
+from .metric import create_metrics
+from .objective import create_objective
+from .boosting import create_boosting
+from .boosting.model_io import (load_model_from_file, load_model_from_string,
+                                save_model_to_file, save_model_to_string)
+from .utils import log
+
+
+class Dataset:
+    """Lazily-constructed training dataset (ref: basic.py:1555 Dataset)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self.position = position
+        self._core: Optional[_CoreDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._core is not None:
+            return self
+        cfg = Config(self.params)
+        ref_core = self.reference._core_or_construct() if self.reference else None
+        if isinstance(self.data, (str, bytes)):
+            self._core = load_dataset_from_file(str(self.data), cfg,
+                                                reference=ref_core)
+            if self.label is not None:
+                self._core.metadata.set_label(self.label)
+        else:
+            data = self.data
+            if hasattr(data, "values"):  # pandas
+                if self.feature_name == "auto":
+                    self.feature_name = list(map(str, data.columns))
+                data = data.values
+            data = np.asarray(data, dtype=np.float64)
+            cat = []
+            if self.categorical_feature not in ("auto", None):
+                for c in self.categorical_feature:
+                    if isinstance(c, str) and self.feature_name != "auto":
+                        cat.append(list(self.feature_name).index(c))
+                    else:
+                        cat.append(int(c))
+            names = (None if self.feature_name == "auto"
+                     else list(self.feature_name))
+            if ref_core is not None:
+                self._core = ref_core.create_valid(
+                    data, label=self.label, weight=self.weight,
+                    group=self.group, init_score=self.init_score)
+            else:
+                self._core = _CoreDataset.construct_from_arrays(
+                    data, label=self.label, weight=self.weight,
+                    group=self.group, init_score=self.init_score,
+                    max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+                    min_data_in_leaf=cfg.min_data_in_leaf,
+                    bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+                    categorical_feature=cat, feature_names=names,
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing,
+                    feature_pre_filter=cfg.feature_pre_filter,
+                    seed=cfg.data_random_seed,
+                    keep_raw_data=cfg.linear_tree or not self.free_raw_data,
+                    max_bin_by_feature=cfg.max_bin_by_feature or None)
+        if self.position is not None:
+            self._core.metadata.set_position(self.position)
+        return self
+
+    def _core_or_construct(self) -> _CoreDataset:
+        self.construct()
+        return self._core
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        core = self._core_or_construct().copy_subrow(
+            np.asarray(used_indices, dtype=np.int64))
+        out = Dataset.__new__(Dataset)
+        out.__dict__.update(self.__dict__)
+        out._core = core
+        out.used_indices = np.asarray(used_indices)
+        return out
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self._core_or_construct().save_binary(filename)
+        return self
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._core is not None:
+            self._core.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._core is not None:
+            self._core.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._core is not None:
+            self._core.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._core is not None:
+            self._core.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        return (self._core.metadata.label if self._core is not None
+                else self.label)
+
+    def get_weight(self):
+        return (self._core.metadata.weight if self._core is not None
+                else self.weight)
+
+    def get_group(self):
+        if self._core is not None and self._core.metadata.query_boundaries is not None:
+            return np.diff(self._core.metadata.query_boundaries)
+        return self.group
+
+    def num_data(self) -> int:
+        return self._core_or_construct().num_data
+
+    def num_feature(self) -> int:
+        return self._core_or_construct().num_total_features
+
+    def feature_names(self) -> List[str]:
+        return self._core_or_construct().feature_names
+
+
+class Booster:
+    """ref: basic.py:2800 Booster (ctypes wrapper there; direct engine here)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        self.name_valid_sets: List[str] = []
+        if train_set is not None:
+            cfg = Config(self.params)
+            train_set.params = {**self.params, **train_set.params}
+            core = train_set._core_or_construct()
+            objective = create_objective(cfg)
+            metrics = create_metrics(cfg)
+            self._gbdt = create_boosting(cfg.boosting, cfg)
+            self._gbdt.init(cfg, core, objective, metrics)
+            self._num_valid = 0
+        elif model_file is not None:
+            self._gbdt = load_model_from_file(model_file)
+        elif model_str is not None:
+            self._gbdt = load_model_from_string(model_str)
+        else:
+            log.fatal("Booster needs train_set, model_file or model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.reference = data.reference or self._train_set
+        core = data._core_or_construct()
+        cfg = self._gbdt.config
+        self._gbdt.add_valid_data(core, name, create_metrics(cfg))
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if stopped
+        (ref: basic.py Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        if train_set is not None:
+            log.fatal("Resetting training data is not yet supported")
+        if fobj is not None:
+            K = self._gbdt.num_tree_per_iteration
+            n = self._gbdt.num_data
+            score = self.__inner_predict_train()
+            grad, hess = fobj(score if K == 1 else score.T, self._train_set)
+            grad = np.asarray(grad, np.float32)
+            hess = np.asarray(hess, np.float32)
+            if K > 1:
+                grad = grad.T.reshape(K, n) if grad.ndim == 2 else grad.reshape(K, n)
+                hess = hess.T.reshape(K, n) if hess.ndim == 2 else hess.reshape(K, n)
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def __inner_predict_train(self) -> np.ndarray:
+        sc = np.asarray(self._gbdt.scores)[:, :self._gbdt.num_data]
+        return sc[0] if sc.shape[0] == 1 else sc
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None):
+        return self._format_eval("training", self._gbdt.eval_train(),
+                                 feval, None)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i, name in enumerate(self.name_valid_sets):
+            out.extend(self._format_eval(name, self._gbdt.eval_valid(i),
+                                         feval, i))
+        return out
+
+    def _format_eval(self, name, results, feval, valid_idx):
+        from .metric import _METRIC_CLASSES
+        out = []
+        for metric_name, val in results:
+            base = metric_name.split("@")[0]
+            cls = _METRIC_CLASSES.get(base)
+            hib = bool(cls and cls.is_higher_better)
+            out.append((name, metric_name, val, hib))
+        if feval is not None:
+            if valid_idx is None:
+                score = self.__inner_predict_train()
+                dset = self._train_set
+            else:
+                sc = self._gbdt.valid_scores[valid_idx]
+                score = sc[0] if sc.shape[0] == 1 else sc
+                dset = None
+            res = feval(score, dset)
+            if res:
+                if not isinstance(res[0], (list, tuple)):
+                    res = [res]
+                for metric_name, val, hib in res:
+                    out.append((name, metric_name, val, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if hasattr(data, "values"):
+            data = data.values
+        if pred_contrib:
+            log.fatal("pred_contrib (SHAP) is not implemented yet")
+        if num_iteration is None:
+            num_iteration = -1
+        if self.best_iteration > 0 and num_iteration == -1:
+            num_iteration = self.best_iteration
+        return self._gbdt.predict(np.asarray(data, np.float64),
+                                  raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=num_iteration,
+                                  pred_leaf=pred_leaf)
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        return save_model_to_string(self._gbdt, num_iteration, start_iteration,
+                                    importance_type)
+
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        save_model_to_file(self._gbdt, filename, num_iteration, start_iteration,
+                           importance_type)
+        return self
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration=None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt.train_data is not None:
+            return self._gbdt.train_data.feature_names
+        return self._gbdt._loaded_feature_names
+
+    def num_feature(self) -> int:
+        if self._gbdt.train_data is not None:
+            return self._gbdt.train_data.num_total_features
+        return self._gbdt._loaded_max_feature_idx + 1

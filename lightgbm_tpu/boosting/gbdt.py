@@ -1,0 +1,467 @@
+"""GBDT boosting driver (ref: src/boosting/gbdt.cpp, gbdt.h:37).
+
+Orchestrates the TPU training loop: binned data and scores live on device; per
+iteration the objective's gradient map, bagging mask, the jitted whole-tree
+grower and the score update all run as XLA computations.  Trees are pulled to
+host as `Tree` objects (one small D2H per tree, like the CUDA learner's
+CUDATree::ToHost, ref: src/io/cuda/cuda_tree.cpp) for model serialization and
+raw-feature prediction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.binning import BIN_CATEGORICAL
+from ..io.dataset import Dataset
+from ..learner import FeatureMeta, GrowParams, grow_tree
+from ..models.tree import Tree
+from ..objective import ObjectiveFunction
+from ..ops.split import SplitParams
+from ..metric import Metric
+from ..utils import log
+
+K_EPSILON = 1e-15
+_PAD = 1024  # row padding multiple (histogram chunking requirement)
+
+
+def _pad_rows(arr: np.ndarray, n_pad: int, axis: int = -1, fill=0):
+    n = arr.shape[axis]
+    if n == n_pad:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, n_pad - n)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
+                         left_child, right_child, num_leaves,
+                         missing_type, num_bin, default_bin,
+                         binned: np.ndarray) -> np.ndarray:
+    """Vectorized bin-space tree traversal on host (mirror of the device
+    partition rule; ref: dense_bin.hpp:346-366 SplitInner)."""
+    from ..io.binning import MISSING_NAN, MISSING_ZERO
+    n = binned.shape[1]
+    if num_leaves <= 1:
+        return np.zeros(n, dtype=np.int32)
+    node = np.zeros(n, dtype=np.int32)
+    for _ in range(num_leaves):
+        active = node >= 0
+        if not active.any():
+            break
+        nd = node[active]
+        f = split_feature_inner[nd]
+        b = binned[f, np.nonzero(active)[0]]
+        mt = missing_type[f]
+        is_missing = (((mt == MISSING_NAN) & (b == num_bin[f] - 1))
+                      | ((mt == MISSING_ZERO) & (b == default_bin[f])))
+        go_left = np.where(is_missing, default_left[nd], b <= threshold_bin[nd])
+        node[active] = np.where(go_left, left_child[nd], right_child[nd])
+    return (~node).astype(np.int32)
+
+
+class GBDT:
+    """ref: src/boosting/gbdt.cpp GBDT."""
+
+    def __init__(self):
+        self.models_: List[Tree] = []
+        self.iter_ = 0
+        self.config: Optional[Config] = None
+        self.train_data: Optional[Dataset] = None
+        self.objective: Optional[ObjectiveFunction] = None
+        self.best_iteration = -1
+
+    # ------------------------------------------------------------------ init
+    def init(self, config: Config, train_data: Dataset,
+             objective: Optional[ObjectiveFunction],
+             metrics: Sequence[Metric]) -> None:
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.train_metrics = list(metrics)
+        self.shrinkage_rate = config.learning_rate
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (objective.num_model_per_iteration()
+                                       if objective is not None else config.num_class)
+        self.num_data = train_data.num_data
+        self.valid_sets: List[Dataset] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_names: List[str] = []
+        self.valid_scores: List[np.ndarray] = []
+        self.class_need_train = [True] * self.num_tree_per_iteration
+
+        n = train_data.num_data
+        self.n_pad = (n + _PAD - 1) // _PAD * _PAD
+        binned = train_data.binned
+        dtype = np.uint8 if train_data.max_num_bin <= 256 else np.int32
+        self.binned_dev = jnp.asarray(
+            _pad_rows(binned.astype(dtype), self.n_pad))
+        self.pad_mask = jnp.asarray(
+            _pad_rows(np.ones(n, np.float32), self.n_pad))
+
+        # per-feature metadata, device side
+        mt, nb, db, cat = [], [], [], []
+        for f in train_data.used_features:
+            m = train_data.bin_mappers[f]
+            mt.append(m.missing_type)
+            nb.append(m.num_bin)
+            db.append(m.default_bin)
+            cat.append(m.bin_type == BIN_CATEGORICAL)
+        self.f_missing_type = np.array(mt, np.int32)
+        self.f_num_bin = np.array(nb, np.int32)
+        self.f_default_bin = np.array(db, np.int32)
+        self.f_is_cat = np.array(cat, bool)
+        if self.f_is_cat.any():
+            log.warning("categorical splits are trained as numerical in this "
+                        "version (sorted-category scan lands later)")
+        penalty = np.ones(len(nb), np.float32)
+        if config.feature_contri:
+            for i, f in enumerate(train_data.used_features):
+                if f < len(config.feature_contri):
+                    penalty[i] = config.feature_contri[f]
+        self.meta = FeatureMeta(
+            num_bin=jnp.asarray(self.f_num_bin),
+            missing_type=jnp.asarray(self.f_missing_type),
+            default_bin=jnp.asarray(self.f_default_bin),
+            penalty=jnp.asarray(penalty))
+
+        max_b = int(self.f_num_bin.max()) if len(nb) else 1
+        # histogram stack memory guard (HistogramPool analogue)
+        stack_bytes = config.num_leaves * len(nb) * max_b * 2 * 4
+        budget = (config.histogram_pool_size * 1024 * 1024
+                  if config.histogram_pool_size > 0 else 512 * 1024 * 1024)
+        self.grow_params = GrowParams(
+            num_leaves=config.num_leaves,
+            max_depth=config.max_depth,
+            max_bin=max_b,
+            split=SplitParams(
+                lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
+                min_data_in_leaf=config.min_data_in_leaf,
+                min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+                min_gain_to_split=config.min_gain_to_split,
+                max_delta_step=config.max_delta_step,
+                path_smooth=config.path_smooth),
+            use_hist_stack=stack_bytes <= budget,
+            hist_method="segment")
+
+        # scores [K, n_pad] on device
+        K = self.num_tree_per_iteration
+        self.scores = jnp.zeros((K, self.n_pad), jnp.float32)
+        md = train_data.metadata
+        self.has_init_score = md.init_score is not None
+        if self.has_init_score:
+            init = np.asarray(md.init_score, np.float64)
+            if len(init) == n:
+                init = np.tile(init, (K, 1)) if K > 1 else init[None, :]
+            else:
+                init = init.reshape(K, n)
+            self.scores = jnp.asarray(
+                _pad_rows(init.astype(np.float32), self.n_pad))
+
+        if objective is not None:
+            objective.init(md, n)
+            # objective.label may be transformed (e.g. reg_sqrt) — use it
+            self.label_dev = jnp.asarray(
+                _pad_rows(np.asarray(objective.label, np.float32), self.n_pad))
+            self.weight_dev = (None if md.weight is None else jnp.asarray(
+                _pad_rows(np.asarray(md.weight, np.float32), self.n_pad)))
+            if getattr(objective, "need_train", True) is False:
+                self.class_need_train = [False] * K
+        for m in self.train_metrics:
+            m.init(md, n)
+        self.init_scores_applied = [0.0] * K
+        self._rng_bag = np.random.RandomState(config.bagging_seed)
+        self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
+        self._bag_mask_host = np.ones(self.n_pad, np.float32)
+        self._bag_mask_host[n:] = 0.0
+        self.bag_mask = jnp.asarray(self._bag_mask_host)
+
+    def add_valid_data(self, valid_data: Dataset, name: str,
+                       metrics: Sequence[Metric]) -> None:
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name)
+        ms = list(metrics)
+        for m in ms:
+            m.init(valid_data.metadata, valid_data.num_data)
+        self.valid_metrics.append(ms)
+        K = self.num_tree_per_iteration
+        sc = np.zeros((K, valid_data.num_data), np.float64)
+        md = valid_data.metadata
+        if md.init_score is not None:
+            init = np.asarray(md.init_score, np.float64)
+            sc += (np.tile(init, (K, 1)) if init.ndim == 1 and K > 1
+                   else init.reshape(K, -1))
+        self.valid_scores.append(sc)
+
+    # ------------------------------------------------------------------ train
+    def _boost_from_average(self, class_id: int) -> float:
+        """ref: gbdt.cpp:313 BoostFromAverage."""
+        cfg, obj = self.config, self.objective
+        if self.models_ or self.has_init_score or obj is None:
+            return 0.0
+        if cfg.boost_from_average or self.train_data.num_features == 0:
+            init = obj.boost_from_score(class_id)
+            if abs(init) > K_EPSILON:
+                self.scores = self.scores.at[class_id].add(init)
+                for sc in self.valid_scores:
+                    sc[class_id] += init
+                log.info(f"Start training from score {init:.6f}")
+                return init
+        elif obj.name in ("regression_l1", "quantile", "mape"):
+            log.warning(f"Disabling boost_from_average in {obj.name} "
+                        "may cause the slow convergence")
+        return 0.0
+
+    def _compute_gradients(self):
+        """Per-class gradients [K, n_pad] (ref: gbdt.cpp:220 Boosting)."""
+        obj = self.objective
+        if getattr(obj, "run_on_host", False):
+            score_h = np.asarray(self.scores[0])[:self.num_data].astype(np.float64)
+            g, h = obj.get_gradients_host(score_h)
+            grad = jnp.asarray(_pad_rows(g, self.n_pad))[None, :]
+            hess = jnp.asarray(_pad_rows(h, self.n_pad))[None, :]
+            return grad, hess
+        K = self.num_tree_per_iteration
+        if K > 1 and obj.num_model_per_iteration() == K:
+            g, h = obj.get_gradients(self.scores, self.label_dev, self.weight_dev)
+            return g, h
+        g, h = obj.get_gradients(self.scores[0], self.label_dev, self.weight_dev)
+        return g[None, :], h[None, :]
+
+    def _update_bagging(self):
+        """Row-mask bagging (ref: src/boosting/bagging.hpp)."""
+        cfg = self.config
+        n = self.num_data
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            if self.iter_ % cfg.bagging_freq == 0:
+                cnt = int(n * cfg.bagging_fraction)
+                mask = np.zeros(self.n_pad, np.float32)
+                idx = self._rng_bag.choice(n, cnt, replace=False)
+                mask[idx] = 1.0
+                self._bag_mask_host = mask
+                self.bag_mask = jnp.asarray(mask)
+        return self.bag_mask
+
+    def _col_mask(self):
+        cfg = self.config
+        F = self.train_data.num_features
+        if cfg.feature_fraction >= 1.0:
+            return jnp.ones(F, bool)
+        cnt = max(1, int(round(F * cfg.feature_fraction)))
+        mask = np.zeros(F, bool)
+        mask[self._rng_feat.choice(F, cnt, replace=False)] = True
+        return jnp.asarray(mask)
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (ref: gbdt.cpp:338 TrainOneIter)."""
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+        if gradients is None:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            grad, hess = self._compute_gradients()
+        else:
+            grad = jnp.asarray(_pad_rows(np.asarray(gradients, np.float32)
+                                         .reshape(K, -1), self.n_pad))
+            hess = jnp.asarray(_pad_rows(np.asarray(hessians, np.float32)
+                                         .reshape(K, -1), self.n_pad))
+
+        bag_mask = self._update_bagging()
+        should_continue = False
+        for k in range(K):
+            tree = None
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                arrays, leaf_id = grow_tree(
+                    self.binned_dev, grad[k], hess[k], bag_mask,
+                    self._col_mask(), self.meta, self.grow_params)
+                tree = self._finalize_tree(arrays, leaf_id, k, init_scores[k])
+            if tree is None:
+                tree = Tree(2)
+                tree.num_leaves = 1
+                if len(self.models_) < K:
+                    if (self.objective is not None
+                            and not self.config.boost_from_average
+                            and not self.has_init_score):
+                        init_scores[k] = self.objective.boost_from_score(k)
+                        self.scores = self.scores.at[k].add(init_scores[k])
+                        for sc in self.valid_scores:
+                            sc[k] += init_scores[k]
+                    tree.leaf_value[0] = init_scores[k]
+                    tree.shrinkage = 1.0
+            else:
+                should_continue = True
+            self.models_.append(tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models_) > K:
+                del self.models_[-K:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _finalize_tree(self, arrays, leaf_id, class_id: int,
+                       init_score: float) -> Optional[Tree]:
+        """Device TreeArrays -> host Tree; renew/shrink/score-update
+        (ref: gbdt.cpp:395-407)."""
+        num_leaves = int(arrays.num_leaves)
+        if num_leaves <= 1:
+            return None
+        ds = self.train_data
+        L = self.config.num_leaves
+        tree = Tree(max(L, 2))
+        tree.num_leaves = num_leaves
+        ni = num_leaves - 1
+        sf_inner = np.asarray(arrays.split_feature)[:ni]
+        thr_bin = np.asarray(arrays.threshold_bin)[:ni]
+        dleft = np.asarray(arrays.default_left)[:ni]
+        tree.split_feature_inner[:ni] = sf_inner
+        tree.split_feature[:ni] = np.array(
+            [ds.used_features[f] for f in sf_inner], np.int32)
+        tree.threshold_in_bin[:ni] = thr_bin
+        for i in range(ni):
+            mapper = ds.bin_mappers[tree.split_feature[i]]
+            tree.threshold[i] = mapper.bin_to_value(int(thr_bin[i]))
+            dt = 0
+            if dleft[i]:
+                dt |= 2
+            dt |= (mapper.missing_type & 3) << 2
+            tree.decision_type[i] = dt
+        tree.split_gain[:ni] = np.asarray(arrays.split_gain)[:ni]
+        tree.left_child[:ni] = np.asarray(arrays.left_child)[:ni]
+        tree.right_child[:ni] = np.asarray(arrays.right_child)[:ni]
+        tree.internal_value[:ni] = np.asarray(arrays.internal_value)[:ni]
+        tree.internal_weight[:ni] = np.asarray(arrays.internal_weight)[:ni]
+        tree.internal_count[:ni] = np.asarray(arrays.internal_count)[:ni]
+        nl = num_leaves
+        tree.leaf_value[:nl] = np.asarray(arrays.leaf_value)[:nl]
+        tree.leaf_weight[:nl] = np.asarray(arrays.leaf_weight)[:nl]
+        tree.leaf_count[:nl] = np.asarray(arrays.leaf_count)[:nl]
+        tree.leaf_parent[:nl] = np.asarray(arrays.leaf_parent)[:nl]
+        tree.leaf_depth[:nl] = np.asarray(arrays.leaf_depth)[:nl]
+
+        # per-leaf output renewal (ref: RenewTreeOutput; L1/quantile/MAPE)
+        obj = self.objective
+        leaf_id_host = None
+        if obj is not None and obj.need_renew_tree_output:
+            leaf_id_host = np.asarray(leaf_id)[:self.num_data]
+            score_host = np.asarray(self.scores[class_id])[:self.num_data]
+            bag = self._bag_mask_host[:self.num_data] > 0
+            renewed = obj.renew_tree_output(
+                np.where(bag, leaf_id_host, -1), score_host, num_leaves)
+            if renewed is not None:
+                tree.leaf_value[:nl] = renewed
+
+        tree.apply_shrinkage(self.shrinkage_rate)
+
+        # score update on device (ref: ScoreUpdater::AddScore(tree_learner))
+        leaf_vals = jnp.asarray(tree.leaf_value[:max(L, 2)].astype(np.float32))
+        self.scores = self.scores.at[class_id].add(
+            jnp.take(leaf_vals, jnp.clip(leaf_id, 0, max(L, 2) - 1)) * self.pad_mask)
+        # valid scores on host
+        for vi, vds in enumerate(self.valid_sets):
+            vleaf = leaf_index_bin_space(
+                sf_inner, thr_bin, dleft,
+                tree.left_child[:ni], tree.right_child[:ni], num_leaves,
+                self.f_missing_type, self.f_num_bin, self.f_default_bin,
+                vds.binned)
+            self.valid_scores[vi][class_id] += tree.leaf_value[vleaf]
+
+        if abs(init_score) > K_EPSILON:
+            tree.add_bias(init_score)
+        return tree
+
+    # ------------------------------------------------------------------- eval
+    def eval_train(self):
+        score = np.asarray(self.scores)[:, :self.num_data].astype(np.float64)
+        return self._eval(score, self.train_metrics, self.train_data)
+
+    def eval_valid(self, idx: int):
+        return self._eval(self.valid_scores[idx], self.valid_metrics[idx],
+                          self.valid_sets[idx])
+
+    def _eval(self, score, metrics, dataset):
+        out = []
+        sc = score[0] if score.shape[0] == 1 else score
+        for m in metrics:
+            out.extend(m.eval(sc, self.objective))
+        return out
+
+    # ---------------------------------------------------------------- predict
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw scores [n] or [n, K] (ref: gbdt_prediction.cpp PredictRaw)."""
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models_) // K
+        if num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        out = np.zeros((K, n))
+        for it in range(start_iteration, end):
+            for k in range(K):
+                out[k] += self.models_[it * K + k].predict(X)
+        return out[0] if K == 1 else out.T
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1,
+                pred_leaf: bool = False) -> np.ndarray:
+        if pred_leaf:
+            return self.predict_leaf_index(X, start_iteration, num_iteration)
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        import jax.numpy as jnp_
+        if raw.ndim == 2:
+            return np.asarray(self.objective.convert_output(jnp_.asarray(raw.T))).T
+        return np.asarray(self.objective.convert_output(jnp_.asarray(raw)))
+
+    def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models_) // K
+        if num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        cols = []
+        for it in range(start_iteration, end):
+            for k in range(K):
+                cols.append(self.models_[it * K + k].get_leaf_index(X))
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0), np.int32)
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models_)
+
+    def current_iteration(self) -> int:
+        return len(self.models_) // max(self.num_tree_per_iteration, 1)
+
+    def rollback_one_iter(self) -> None:
+        """ref: gbdt.cpp:443 RollbackOneIter (model-side only; scores are
+        rebuilt lazily on next use)."""
+        K = self.num_tree_per_iteration
+        if len(self.models_) >= K:
+            del self.models_[-K:]
+            self.iter_ -= 1
+
+    # --------------------------------------------------------------- model IO
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        F = self.train_data.num_total_features if self.train_data else (
+            max(int(t.split_feature[:t.num_leaves - 1].max(initial=0))
+                for t in self.models_) + 1 if self.models_ else 0)
+        out = np.zeros(F)
+        for t in self.models_:
+            if importance_type == "split":
+                out += t.feature_importance_split(F)
+            else:
+                out += t.feature_importance_gain(F)
+        return out

@@ -1,0 +1,185 @@
+"""Model text (de)serialization, line-compatible with the reference's v4 format
+(ref: src/boosting/gbdt_model_text.cpp SaveModelToString/LoadModelFromString).
+
+The text model is also the checkpoint format (ref: SURVEY.md §5 checkpoint/resume:
+snapshot_freq writes model.snapshot_iter_N; resume = load + continue training).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.tree import Tree
+from ..utils import log
+
+
+def objective_to_string(objective, config) -> str:
+    """ref: each objective's ToString()."""
+    if objective is None:
+        if config is not None and config.objective not in ("custom", ""):
+            return config.objective
+        return "custom"
+    name = objective.name
+    if name == "binary":
+        return f"binary sigmoid:{objective.sigmoid:g}"
+    if name in ("multiclass", "multiclassova"):
+        s = f"{name} num_class:{objective.num_class}"
+        if name == "multiclassova":
+            s += f" sigmoid:{objective.binary[0].sigmoid:g}"
+        return s
+    if name == "quantile":
+        return f"quantile alpha:{objective.alpha:g}"
+    if name == "huber":
+        return f"huber alpha:{objective.alpha:g}"
+    if name == "fair":
+        return f"fair c:{objective.c:g}"
+    if name == "tweedie":
+        return f"tweedie tweedie_variance_power:{objective.rho:g}"
+    if name == "lambdarank":
+        return "lambdarank"
+    if name == "rank_xendcg":
+        return "rank_xendcg"
+    return name
+
+
+def save_model_to_string(booster, num_iteration: int = -1,
+                         start_iteration: int = 0,
+                         importance_type: str = "split") -> str:
+    """ref: gbdt_model_text.cpp GBDT::SaveModelToString."""
+    ds = booster.train_data
+    K = booster.num_tree_per_iteration
+    cfg = booster.config
+    total_iters = len(booster.models_) // max(K, 1)
+    if num_iteration < 0:
+        num_iteration = total_iters - start_iteration
+    end = min(start_iteration + num_iteration, total_iters)
+
+    if ds is not None:
+        max_feature_idx = ds.num_total_features - 1
+        feature_names = ds.feature_names
+        feature_infos = ds.feature_infos()
+    else:
+        max_feature_idx = booster._loaded_max_feature_idx
+        feature_names = booster._loaded_feature_names
+        feature_infos = booster._loaded_feature_infos
+
+    lines = [
+        "tree",
+        "version=v4",
+        f"num_class={cfg.num_class if cfg else K}",
+        f"num_tree_per_iteration={K}",
+        "label_index=0",
+        f"max_feature_idx={max_feature_idx}",
+        f"objective={objective_to_string(booster.objective, cfg)}",
+        "feature_names=" + " ".join(feature_names),
+        "feature_infos=" + " ".join(feature_infos),
+    ]
+    tree_blocks = []
+    for it in range(start_iteration, end):
+        for k in range(K):
+            idx = it * K + k
+            tree_blocks.append(booster.models_[idx].to_string(len(tree_blocks)))
+    lines.append("tree_sizes=" + " ".join(str(len(b)) for b in tree_blocks))
+    lines.append("")
+    out = "\n".join(lines) + "\n"
+    out += "\n".join(tree_blocks)
+    out += "\nend of trees\n"
+
+    imp = booster.feature_importance(importance_type)
+    order = np.argsort(-imp, kind="stable")
+    out += "\nfeature_importances:\n"
+    for f in order:
+        if imp[f] > 0 and f < len(feature_names):
+            out += f"{feature_names[f]}={imp[f]:g}\n"
+    out += "\nparameters:\n"
+    if cfg is not None:
+        for key, val in sorted(cfg.changed_params().items()):
+            if isinstance(val, list):
+                val = ",".join(str(v) for v in val)
+            out += f"[{key}: {val}]\n"
+    out += "end of parameters\n"
+    out += "\npandas_categorical:null\n"
+    return out
+
+
+def load_model_from_string(text: str):
+    """ref: gbdt_model_text.cpp GBDT::LoadModelFromString.  Returns a GBDT in
+    predictor mode (no train data)."""
+    from ..config import Config
+    from ..objective import create_objective
+    from .gbdt import GBDT
+
+    booster = GBDT()
+    head, _, rest = text.partition("\nTree=")
+    kv: Dict[str, str] = {}
+    for line in head.splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            kv[k.strip()] = v.strip()
+    if "version" not in kv:
+        log.warning("Unknown model format version")
+    num_class = int(kv.get("num_class", "1"))
+    K = int(kv.get("num_tree_per_iteration", str(num_class)))
+    booster.num_class = num_class
+    booster.num_tree_per_iteration = K
+    booster._loaded_max_feature_idx = int(kv.get("max_feature_idx", "0"))
+    booster._loaded_feature_names = kv.get("feature_names", "").split()
+    booster._loaded_feature_infos = kv.get("feature_infos", "").split()
+
+    obj_str = kv.get("objective", "custom")
+    obj_tokens = obj_str.split()
+    params = {"objective": obj_tokens[0], "num_class": num_class, "verbosity": -1}
+    for tok in obj_tokens[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            params[{"num_class": "num_class", "sigmoid": "sigmoid",
+                    "alpha": "alpha", "c": "fair_c",
+                    "tweedie_variance_power": "tweedie_variance_power"}
+                   .get(k, k)] = v
+    cfg = Config(params)
+    booster.config = cfg
+    try:
+        obj = create_objective(cfg)
+        if obj is not None and obj_tokens[0] not in ("lambdarank", "rank_xendcg"):
+            # predictor-mode init with a dummy label so convert_output works
+            class _MD:
+                label = np.zeros(1, np.float32)
+                weight = None
+                init_score = None
+                query_boundaries = None
+            if obj_tokens[0] not in ("multiclass", "multiclassova"):
+                obj.init(_MD(), 1)
+        booster.objective = obj
+    except Exception:  # custom/unknown objective: raw-score predictor
+        booster.objective = None
+
+    # tree blocks
+    if rest:
+        body = "Tree=" + rest
+        end_pos = body.find("end of trees")
+        body = body[:end_pos] if end_pos >= 0 else body
+        blocks = body.split("\nTree=")
+        for i, blk in enumerate(blocks):
+            blk = blk.strip()
+            if not blk:
+                continue
+            if not blk.startswith("Tree="):
+                blk = "Tree=" + blk
+            booster.models_.append(Tree.from_string(blk))
+    booster.iter_ = len(booster.models_) // max(K, 1)
+    return booster
+
+
+def save_model_to_file(booster, filename: str, num_iteration: int = -1,
+                       start_iteration: int = 0,
+                       importance_type: str = "split") -> None:
+    with open(filename, "w") as f:
+        f.write(save_model_to_string(booster, num_iteration, start_iteration,
+                                     importance_type))
+
+
+def load_model_from_file(filename: str):
+    with open(filename) as f:
+        return load_model_from_string(f.read())

@@ -1,0 +1,145 @@
+"""Training entry points: train() and cv() (ref: python-package/lightgbm/engine.py)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
+from .config import Config
+from .utils import log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          fobj=None) -> Booster:
+    """ref: engine.py:66 train."""
+    params = dict(params or {})
+    cfg = Config(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+
+    if init_model is not None:
+        log.fatal("init_model (continued training) is not yet supported")
+
+    booster = Booster(params=params, train_set=train_set)
+    train_in_valid = False
+    if valid_sets:
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                train_in_valid = True
+                continue
+            name = (valid_names[i] if valid_names and i < len(valid_names)
+                    else f"valid_{i}")
+            booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round > 0 and valid_sets:
+        callbacks.append(early_stopping(cfg.early_stopping_round,
+                                        cfg.first_metric_only,
+                                        verbose=cfg.verbosity >= 1,
+                                        min_delta=cfg.early_stopping_min_delta))
+    if cfg.verbosity >= 1 and cfg.metric_freq > 0:
+        callbacks.append(log_evaluation(cfg.metric_freq))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    booster.best_iteration = -1
+    train_has_metric = bool(cfg.is_provide_training_metric) or train_in_valid
+    try:
+        for i in range(num_boost_round):
+            env = CallbackEnv(model=booster, params=params, iteration=i,
+                              begin_iteration=0, end_iteration=num_boost_round,
+                              evaluation_result_list=[])
+            for cb in callbacks_before:
+                cb(env)
+            stopped = booster.update(fobj=fobj)
+            if stopped:
+                break
+            evals = []
+            if train_has_metric:
+                evals.extend(booster.eval_train(feval))
+            evals.extend(booster.eval_valid(feval))
+            env.evaluation_result_list = evals
+            for cb in callbacks_after:
+                cb(env)
+    except EarlyStopException as e:
+        booster.best_iteration = e.best_iteration + 1
+        for name, metric, value, _ in e.best_score:
+            booster.best_score.setdefault(name, {})[metric] = value
+    if booster.best_iteration < 0:
+        evals = booster.eval_valid(feval)
+        for name, metric, value, _ in evals:
+            booster.best_score.setdefault(name, {})[metric] = value
+    return booster
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None, init_model=None,
+       callbacks: Optional[List[Callable]] = None, seed: int = 0,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (ref: engine.py:580 cv)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+    core = train_set._core_or_construct()
+    n = core.num_data
+    label = np.asarray(core.metadata.label)
+    rng = np.random.RandomState(seed)
+
+    if folds is None:
+        idx = np.arange(n)
+        if shuffle:
+            rng.shuffle(idx)
+        if stratified and cfg.objective in ("binary", "multiclass", "multiclassova"):
+            order = np.argsort(label[idx], kind="stable")
+            idx = idx[order]
+            fold_of = np.arange(n) % nfold
+            folds = [(idx[fold_of != k], idx[fold_of == k]) for k in range(nfold)]
+        else:
+            folds = [(np.concatenate([idx[:a], idx[b:]]), idx[a:b])
+                     for a, b in ((k * n // nfold, (k + 1) * n // nfold)
+                                  for k in range(nfold))]
+
+    boosters = []
+    histories: List[Dict[str, List[float]]] = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.sort(train_idx))
+        va = train_set.subset(np.sort(test_idx))
+        from .callback import record_evaluation
+        hist: Dict[str, Dict[str, List[float]]] = {}
+        cbs = list(callbacks or []) + [record_evaluation(hist)]
+        bst = train(params, tr, num_boost_round, valid_sets=[va],
+                    valid_names=["valid"], feval=feval, callbacks=cbs)
+        boosters.append(bst)
+        histories.append(hist.get("valid", {}))
+
+    out: Dict[str, List[float]] = {}
+    for metric in (histories[0].keys() if histories else []):
+        rounds = min(len(h.get(metric, [])) for h in histories)
+        mean = [float(np.mean([h[metric][i] for h in histories]))
+                for i in range(rounds)]
+        std = [float(np.std([h[metric][i] for h in histories]))
+               for i in range(rounds)]
+        out[f"valid {metric}-mean"] = mean
+        out[f"valid {metric}-stdv"] = std
+    if return_cvbooster:
+        out["cvbooster"] = boosters
+    return out

@@ -1,0 +1,422 @@
+"""Evaluation metrics (ref: src/metric/: regression_metric.hpp, binary_metric.hpp,
+multiclass_metric.hpp, rank_metric.hpp, map_metric.hpp, xentropy_metric.hpp,
+dcg_calculator.cpp; factory src/metric/metric.cpp:19).
+
+Host-side NumPy implementations: metrics run once per `metric_freq` iterations
+on scores pulled from device; pointwise transforms mirror the reference's use of
+ObjectiveFunction::ConvertOutput.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import Config
+from .utils import log
+
+
+class Metric:
+    """Base (ref: include/LightGBM/metric.h)."""
+
+    name: str = ""
+    is_higher_better = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, dtype=np.float64)
+        self.weight = (None if metadata.weight is None
+                       else np.asarray(metadata.weight, dtype=np.float64))
+        self.sum_weights = (float(num_data) if self.weight is None
+                            else float(self.weight.sum()))
+        self.query_boundaries = metadata.query_boundaries
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+    def _convert(self, score, objective):
+        if objective is not None:
+            import jax.numpy as jnp
+            return np.asarray(objective.convert_output(jnp.asarray(score)))
+        return score
+
+    def _avg(self, pointwise: np.ndarray) -> float:
+        if self.weight is None:
+            return float(pointwise.sum() / self.sum_weights)
+        return float((pointwise * self.weight).sum() / self.sum_weights)
+
+
+# ------------------------------------------------------------------ regression
+class _PointwiseRegression(Metric):
+    def loss(self, label, score):
+        raise NotImplementedError
+
+    def eval(self, score, objective=None):
+        conv = self._convert(score, objective)
+        return [(self.name, self._avg(self.loss(self.label, conv)))]
+
+
+class L2Metric(_PointwiseRegression):
+    name = "l2"
+    def loss(self, label, score):
+        return (score - label) ** 2
+
+
+class RMSEMetric(_PointwiseRegression):
+    name = "rmse"
+    def eval(self, score, objective=None):
+        conv = self._convert(score, objective)
+        return [(self.name, float(np.sqrt(self._avg((conv - self.label) ** 2))))]
+
+
+class L1Metric(_PointwiseRegression):
+    name = "l1"
+    def loss(self, label, score):
+        return np.abs(score - label)
+
+
+class QuantileMetric(_PointwiseRegression):
+    name = "quantile"
+    def loss(self, label, score):
+        alpha = self.config.alpha
+        delta = label - score
+        return np.where(delta < 0, (alpha - 1.0) * delta, alpha * delta)
+
+
+class HuberMetric(_PointwiseRegression):
+    name = "huber"
+    def loss(self, label, score):
+        a = self.config.alpha
+        diff = np.abs(score - label)
+        return np.where(diff <= a, 0.5 * diff * diff, a * (diff - 0.5 * a))
+
+
+class FairMetric(_PointwiseRegression):
+    name = "fair"
+    def loss(self, label, score):
+        c = self.config.fair_c
+        x = np.abs(score - label)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegression):
+    name = "poisson"
+    def loss(self, label, score):
+        eps = 1e-10
+        s = np.maximum(score, eps)
+        return s - label * np.log(s)
+
+
+class MAPEMetric(_PointwiseRegression):
+    name = "mape"
+    def loss(self, label, score):
+        return np.abs((label - score) / np.maximum(1.0, np.abs(label)))
+
+
+class GammaMetric(_PointwiseRegression):
+    """Gamma negative log-likelihood, psi=1 (ref: regression_metric.hpp GammaMetric)."""
+    name = "gamma"
+    def loss(self, label, score):
+        eps = 1e-10
+        s = np.maximum(score, eps)
+        return np.maximum(label, eps) / s + np.log(s)
+
+
+class GammaDevianceMetric(_PointwiseRegression):
+    name = "gamma_deviance"
+    def loss(self, label, score):
+        eps = 1e-10
+        frac = label / np.maximum(score, eps)
+        return 2.0 * (-np.log(np.maximum(frac, eps)) + frac - 1.0)
+
+
+class TweedieMetric(_PointwiseRegression):
+    name = "tweedie"
+    def loss(self, label, score):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        s = np.maximum(score, eps)
+        a = label * np.power(s, 1.0 - rho) / (1.0 - rho)
+        b = np.power(s, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+# ---------------------------------------------------------------------- binary
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+    def eval(self, score, objective=None):
+        prob = self._convert(score, objective)
+        eps = 1e-15
+        prob = np.clip(prob, eps, 1 - eps)
+        is_pos = self.label > 0
+        pt = np.where(is_pos, -np.log(prob), -np.log(1.0 - prob))
+        return [(self.name, self._avg(pt))]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+    def eval(self, score, objective=None):
+        prob = self._convert(score, objective)
+        pred_pos = prob > 0.5
+        is_pos = self.label > 0
+        return [(self.name, self._avg((pred_pos != is_pos).astype(np.float64)))]
+
+
+class AUCMetric(Metric):
+    """ref: binary_metric.hpp:159 AUCMetric (weighted rank-sum form)."""
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, score, objective=None):
+        order = np.argsort(-score, kind="stable")
+        s = score[order]
+        lab = self.label[order] > 0
+        w = (np.ones(len(s)) if self.weight is None else self.weight[order])
+        # group ties: process equal-score blocks together
+        boundaries = np.nonzero(np.diff(s))[0] + 1
+        idx = np.concatenate([[0], boundaries, [len(s)]])
+        sum_pos = 0.0
+        accum = 0.0
+        cur_neg = 0.0
+        for a, b in zip(idx[:-1], idx[1:]):
+            blk_pos = float((w[a:b] * lab[a:b]).sum())
+            blk_neg = float((w[a:b] * ~lab[a:b]).sum())
+            accum += blk_neg * (sum_pos + blk_pos * 0.5)
+            sum_pos += blk_pos
+            cur_neg += blk_neg
+        if sum_pos == 0 or cur_neg == 0:
+            return [(self.name, 1.0)]
+        return [(self.name, accum / (sum_pos * cur_neg))]
+
+
+class AveragePrecisionMetric(Metric):
+    """ref: binary_metric.hpp AveragePrecisionMetric."""
+    name = "average_precision"
+    is_higher_better = True
+
+    def eval(self, score, objective=None):
+        order = np.argsort(-score, kind="stable")
+        lab = self.label[order] > 0
+        w = (np.ones(len(order)) if self.weight is None else self.weight[order])
+        tp = np.cumsum(w * lab)
+        fp = np.cumsum(w * ~lab)
+        precision = tp / np.maximum(tp + fp, 1e-20)
+        delta_tp = w * lab
+        total_pos = tp[-1]
+        if total_pos == 0:
+            return [(self.name, 1.0)]
+        return [(self.name, float((precision * delta_tp).sum() / total_pos))]
+
+
+# ------------------------------------------------------------------ multiclass
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+    def eval(self, score, objective=None):
+        # score [K, n] raw -> softmax
+        prob = self._convert(score, objective)
+        if prob.ndim == 1:
+            k = self.config.num_class
+            prob = prob.reshape(k, -1)
+        li = self.label.astype(np.int64)
+        p = np.clip(prob[li, np.arange(prob.shape[1])], 1e-15, 1.0)
+        return [(self.name, self._avg(-np.log(p)))]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+    def eval(self, score, objective=None):
+        prob = self._convert(score, objective)
+        if prob.ndim == 1:
+            k = self.config.num_class
+            prob = prob.reshape(k, -1)
+        top_k = self.config.multi_error_top_k
+        li = self.label.astype(np.int64)
+        true_p = prob[li, np.arange(prob.shape[1])]
+        # error if true-class prob is not within top_k (ties count favorably)
+        rank = (prob > true_p[None, :]).sum(axis=0)
+        err = (rank >= top_k).astype(np.float64)
+        return [(self.name, self._avg(err))]
+
+
+# --------------------------------------------------------------------- ranking
+DEFAULT_LABEL_GAIN_SIZE = 31
+
+
+def default_label_gain() -> List[float]:
+    return [float((1 << i) - 1) for i in range(DEFAULT_LABEL_GAIN_SIZE)]
+
+
+class NDCGMetric(Metric):
+    """NDCG@k (ref: rank_metric.hpp:20, dcg_calculator.cpp)."""
+    name = "ndcg"
+    is_higher_better = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.eval_at = list(config.eval_at) or [1, 2, 3, 4, 5]
+        self.label_gain = list(config.label_gain) or default_label_gain()
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("The NDCG metric requires query information")
+
+    def eval(self, score, objective=None):
+        qb = self.query_boundaries
+        gains = np.asarray(self.label_gain)
+        results = {k: [] for k in self.eval_at}
+        for qi in range(len(qb) - 1):
+            a, b = int(qb[qi]), int(qb[qi + 1])
+            lab = self.label[a:b].astype(np.int64)
+            sc = score[a:b]
+            g = gains[lab]
+            order = np.argsort(-sc, kind="stable")
+            ideal = np.sort(g)[::-1]
+            discounts = 1.0 / np.log2(np.arange(len(lab)) + 2.0)
+            for k in self.eval_at:
+                kk = min(k, len(lab))
+                idcg = float((ideal[:kk] * discounts[:kk]).sum())
+                if idcg > 0:
+                    dcg = float((g[order][:kk] * discounts[:kk]).sum())
+                    results[k].append(dcg / idcg)
+                else:
+                    results[k].append(1.0)
+        return [(f"ndcg@{k}", float(np.mean(results[k]))) for k in self.eval_at]
+
+
+class MapMetric(Metric):
+    """MAP@k (ref: map_metric.hpp:17)."""
+    name = "map"
+    is_higher_better = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.eval_at = list(config.eval_at) or [1, 2, 3, 4, 5]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("The MAP metric requires query information")
+
+    def eval(self, score, objective=None):
+        qb = self.query_boundaries
+        results = {k: [] for k in self.eval_at}
+        for qi in range(len(qb) - 1):
+            a, b = int(qb[qi]), int(qb[qi + 1])
+            rel = (self.label[a:b] > 0)[np.argsort(-score[a:b], kind="stable")]
+            npos = int(rel.sum())
+            cum = np.cumsum(rel)
+            prec_at_hit = np.where(rel, cum / (np.arange(len(rel)) + 1.0), 0.0)
+            for k in self.eval_at:
+                kk = min(k, len(rel))
+                denom = min(npos, kk)
+                if denom > 0:
+                    results[k].append(float(prec_at_hit[:kk].sum()) / denom)
+                else:
+                    results[k].append(1.0)
+        return [(f"map@{k}", float(np.mean(results[k]))) for k in self.eval_at]
+
+
+# ---------------------------------------------------------------- cross-entropy
+class CrossEntropyMetric(Metric):
+    name = "cross_entropy"
+    def eval(self, score, objective=None):
+        p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
+        y = self.label
+        pt = -y * np.log(p) - (1 - y) * np.log(1 - p)
+        return [(self.name, self._avg(pt))]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+    def eval(self, score, objective=None):
+        hhat = self._convert(score, objective)  # log1p(exp(score))
+        y = self.label
+        w = self.weight if self.weight is not None else 1.0
+        z = 1.0 - np.exp(-w * hhat)
+        z = np.clip(z, 1e-15, 1 - 1e-15)
+        pt = -y * np.log(z) - (1 - y) * np.log(1 - z)
+        return [(self.name, float(np.mean(pt)))]
+
+
+class KLDivergenceMetric(Metric):
+    name = "kullback_leibler"
+    def eval(self, score, objective=None):
+        p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
+        y = np.clip(self.label, 1e-15, 1 - 1e-15)
+        pt = y * np.log(y / p) + (1 - y) * np.log((1 - y) / (1 - p))
+        return [(self.name, self._avg(pt))]
+
+
+# --------------------------------------------------------------------- factory
+_METRIC_ALIASES = {
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression": "l2",
+    "regression_l2": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "quantile": "quantile", "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc", "average_precision": "average_precision",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multi_error": "multi_error",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "map": "map", "mean_average_precision": "map",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kullback_leibler", "kldiv": "kullback_leibler",
+}
+
+_METRIC_CLASSES = {
+    "l2": L2Metric, "rmse": RMSEMetric, "l1": L1Metric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric, "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric, "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric, "map": MapMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivergenceMetric,
+}
+
+# objective -> default metric (ref: config.cpp Config::GetMetricType)
+_DEFAULT_FOR_OBJECTIVE = {
+    "regression": "l2", "regression_l1": "l1", "huber": "huber", "fair": "fair",
+    "poisson": "poisson", "quantile": "quantile", "mape": "mape",
+    "gamma": "gamma", "tweedie": "tweedie", "binary": "binary_logloss",
+    "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
+    "cross_entropy": "cross_entropy", "cross_entropy_lambda": "cross_entropy_lambda",
+    "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+}
+
+
+def create_metrics(config: Config, for_objective: Optional[str] = None) -> List[Metric]:
+    """ref: src/metric/metric.cpp:19 Metric::CreateMetric + config metric parsing."""
+    names = [str(m).strip().lower() for m in (config.metric or [])]
+    if not names:
+        obj = for_objective or config.objective
+        if obj in _DEFAULT_FOR_OBJECTIVE:
+            names = [_DEFAULT_FOR_OBJECTIVE[obj]]
+    out: List[Metric] = []
+    seen = set()
+    for nm in names:
+        if nm in ("", "na", "null", "none", "custom"):
+            continue
+        canon = _METRIC_ALIASES.get(nm)
+        if canon is None:
+            log.warning(f"Unknown metric: {nm}")
+            continue
+        if canon in seen:
+            continue
+        seen.add(canon)
+        out.append(_METRIC_CLASSES[canon](config))
+    return out

@@ -1,0 +1,551 @@
+"""Objective functions: closed-form gradient/hessian ops in pure jnp.
+
+TPU-native replacement for src/objective/ (ref: regression_objective.hpp,
+binary_objective.hpp, multiclass_objective.hpp, xentropy_objective.hpp) and its
+CUDA twins (src/objective/cuda/): each objective is a pair of jittable maps
+score -> (grad, hess) and score -> prediction, plus a host-side
+boost_from_score (ref: ObjectiveFunction::BoostFromScore) and an optional
+per-leaf output renewal (ref: RenewTreeOutput).
+
+Interface mirrors include/LightGBM/objective_function.h; the factory mirrors
+src/objective/objective_function.cpp:20 CreateObjectiveFunction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .utils import log
+
+
+def _weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
+                         alpha: float) -> float:
+    """ref: regression_objective.hpp:25-90 PercentileFun/WeightedPercentileFun."""
+    if len(values) == 0:
+        return 0.0
+    order = np.argsort(values, kind="stable")
+    v = values[order]
+    if weights is None:
+        if alpha <= 1.0 / (len(v) + 1):
+            return float(v[0])
+        if alpha >= len(v) / (len(v) + 1.0):
+            return float(v[-1])
+        position = alpha * (len(v) + 1)
+        idx = int(np.floor(position)) - 1
+        frac = position - idx - 1
+        return float(v[idx] + frac * (v[idx + 1] - v[idx]))
+    w = weights[order].astype(np.float64)
+    wsum = w.sum()
+    threshold = wsum * alpha
+    cum = np.cumsum(w) - w / 2.0
+    idx = int(np.searchsorted(cum, threshold, side="right")) - 1
+    if idx < 0:
+        return float(v[0])
+    if idx >= len(v) - 1:
+        return float(v[-1])
+    frac = (threshold - cum[idx]) / max(cum[idx + 1] - cum[idx], 1e-300)
+    return float(v[idx] + frac * (v[idx + 1] - v[idx]))
+
+
+class ObjectiveFunction:
+    """Base (ref: include/LightGBM/objective_function.h)."""
+
+    name = "custom"
+    num_model_per_iteration_ = 1
+    is_constant_hessian = False
+    need_renew_tree_output = False
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+
+    def init(self, metadata, num_data: int) -> None:
+        self.label = np.asarray(metadata.label, dtype=np.float32)
+        self.weight = (None if metadata.weight is None
+                       else np.asarray(metadata.weight, dtype=np.float32))
+        self.num_data = num_data
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_model_per_iteration_
+
+    # -- device-side ops ----------------------------------------------------
+    def get_gradients(self, score: jnp.ndarray, label: jnp.ndarray,
+                      weight: Optional[jnp.ndarray]):
+        """score -> (grad, hess); jittable."""
+        raise NotImplementedError
+
+    def convert_output(self, score: jnp.ndarray) -> jnp.ndarray:
+        """Raw score -> prediction space (ref: ObjectiveFunction::ConvertOutput)."""
+        return score
+
+    # -- host-side ----------------------------------------------------------
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    def renew_tree_output(self, leaf_id: np.ndarray, score: np.ndarray,
+                          num_leaves: int) -> Optional[np.ndarray]:
+        """Per-leaf output renewal (ref: RenewTreeOutput); returns [num_leaves]
+        new outputs or None."""
+        return None
+
+    def _apply_weight(self, grad, hess, weight):
+        if weight is not None:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess
+
+
+# ------------------------------------------------------------------ regression
+class RegressionL2(ObjectiveFunction):
+    """ref: regression_objective.hpp:93 RegressionL2loss."""
+    name = "regression"
+    is_constant_hessian = True  # when unweighted
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = config.reg_sqrt
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            self.raw_label = self.label
+            self.label = (np.sign(self.label) *
+                          np.sqrt(np.abs(self.label))).astype(np.float32)
+
+    def get_gradients(self, score, label, weight):
+        grad = score - label
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess, weight)
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        if self.weight is None:
+            return float(np.mean(self.label))
+        return float(np.sum(self.label * self.weight) / np.sum(self.weight))
+
+
+class RegressionL1(RegressionL2):
+    """ref: regression_objective.hpp:206 RegressionL1loss."""
+    name = "regression_l1"
+    need_renew_tree_output = True
+    is_constant_hessian = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+
+    def get_gradients(self, score, label, weight):
+        diff = score - label
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess, weight)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return _weighted_percentile(self.label, self.weight, 0.5)
+
+    def renew_tree_output(self, leaf_id, score, num_leaves):
+        """Per-leaf weighted median of residuals (ref: hpp:243-287)."""
+        out = np.zeros(num_leaves)
+        resid = self.label - score
+        for leaf in range(num_leaves):
+            m = leaf_id == leaf
+            if m.any():
+                w = None if self.weight is None else self.weight[m]
+                out[leaf] = _weighted_percentile(resid[m], w, 0.5)
+        return out
+
+
+class RegressionHuber(RegressionL2):
+    """ref: regression_objective.hpp:292 RegressionHuberLoss."""
+    name = "huber"
+    is_constant_hessian = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = config.alpha
+
+    def get_gradients(self, score, label, weight):
+        diff = score - label
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         jnp.sign(diff) * self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess, weight)
+
+
+class RegressionFair(RegressionL2):
+    """ref: regression_objective.hpp:350 RegressionFairLoss."""
+    name = "fair"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.c = config.fair_c
+
+    def get_gradients(self, score, label, weight):
+        x = score - label
+        c = self.c
+        grad = c * x / (jnp.abs(x) + c)
+        hess = c * c / (jnp.abs(x) + c) ** 2
+        return self._apply_weight(grad, hess, weight)
+
+
+class RegressionPoisson(RegressionL2):
+    """ref: regression_objective.hpp:397 RegressionPoissonLoss
+    (score is log-rate; grad = exp(f) - y, hess = exp(f) * exp(max_delta_step))."""
+    name = "poisson"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.max_delta_step = config.poisson_max_delta_step
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (self.label < 0).any():
+            log.fatal("[poisson]: at least one target label is negative")
+
+    def get_gradients(self, score, label, weight):
+        exp_score = jnp.exp(score)
+        grad = exp_score - label
+        hess = exp_score * float(np.exp(self.max_delta_step))
+        return self._apply_weight(grad, hess, weight)
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(super().boost_from_score(), 1e-20)))
+
+
+class RegressionQuantile(RegressionL2):
+    """ref: regression_objective.hpp:480 RegressionQuantileloss."""
+    name = "quantile"
+    need_renew_tree_output = True
+    is_constant_hessian = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = config.alpha
+
+    def get_gradients(self, score, label, weight):
+        delta = score - label
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess, weight)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return _weighted_percentile(self.label, self.weight, self.alpha)
+
+    def renew_tree_output(self, leaf_id, score, num_leaves):
+        out = np.zeros(num_leaves)
+        resid = self.label - score
+        for leaf in range(num_leaves):
+            m = leaf_id == leaf
+            if m.any():
+                w = None if self.weight is None else self.weight[m]
+                out[leaf] = _weighted_percentile(resid[m], w, self.alpha)
+        return out
+
+
+class RegressionMAPE(RegressionL1):
+    """ref: regression_objective.hpp:578 RegressionMAPELOSS."""
+    name = "mape"
+    need_renew_tree_output = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.label_weight = (1.0 / np.maximum(1.0, np.abs(self.label))
+                             ).astype(np.float32)
+        if self.weight is not None:
+            self.label_weight = self.label_weight * self.weight
+
+    def get_gradients(self, score, label, weight):
+        lw = 1.0 / jnp.maximum(1.0, jnp.abs(label))
+        if weight is not None:
+            lw = lw * weight
+        diff = score - label
+        grad = jnp.sign(diff) * lw
+        hess = jnp.ones_like(score) if weight is None else weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return _weighted_percentile(self.label, self.label_weight, 0.5)
+
+    def renew_tree_output(self, leaf_id, score, num_leaves):
+        out = np.zeros(num_leaves)
+        resid = self.label - score
+        for leaf in range(num_leaves):
+            m = leaf_id == leaf
+            if m.any():
+                out[leaf] = _weighted_percentile(resid[m], self.label_weight[m], 0.5)
+        return out
+
+
+class RegressionGamma(RegressionPoisson):
+    """ref: regression_objective.hpp:679 RegressionGammaLoss."""
+    name = "gamma"
+
+    def get_gradients(self, score, label, weight):
+        exp_neg = jnp.exp(-score)
+        grad = 1.0 - label * exp_neg
+        hess = label * exp_neg
+        return self._apply_weight(grad, hess, weight)
+
+
+class RegressionTweedie(RegressionPoisson):
+    """ref: regression_objective.hpp:717 RegressionTweedieLoss."""
+    name = "tweedie"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.rho = config.tweedie_variance_power
+
+    def get_gradients(self, score, label, weight):
+        rho = self.rho
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        grad = -label * e1 + e2
+        hess = -label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return self._apply_weight(grad, hess, weight)
+
+
+# ---------------------------------------------------------------------- binary
+class BinaryLogloss(ObjectiveFunction):
+    """ref: binary_objective.hpp:20 BinaryLogloss."""
+    name = "binary"
+
+    def __init__(self, config: Config, is_pos=None):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+        self.is_unbalance = config.is_unbalance
+        self.scale_pos_weight = config.scale_pos_weight
+        self.is_pos = is_pos or (lambda label: label > 0)
+        if self.sigmoid <= 0:
+            log.fatal(f"Sigmoid parameter {self.sigmoid} should be greater than zero")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        pos = self.is_pos(self.label)
+        cnt_pos, cnt_neg = int(pos.sum()), int((~pos).sum())
+        w_pos, w_neg = 1.0, 1.0
+        if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w_neg = cnt_pos / cnt_neg
+            else:
+                w_pos = cnt_neg / cnt_pos
+        w_pos *= self.scale_pos_weight
+        self.w_pos, self.w_neg = w_pos, w_neg
+        self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
+        self.need_train = not (cnt_neg == 0 or cnt_pos == 0)
+        if not self.need_train:
+            log.warning("Contains only one class")
+
+    def get_gradients(self, score, label, weight):
+        pos = self.is_pos(label)  # predicate is jnp-compatible
+        lv = jnp.where(pos, 1.0, -1.0)
+        lw = jnp.where(pos, self.w_pos, self.w_neg)
+        response = -lv * self.sigmoid / (1.0 + jnp.exp(lv * self.sigmoid * score))
+        abs_resp = jnp.abs(response)
+        grad = response * lw
+        hess = abs_resp * (self.sigmoid - abs_resp) * lw
+        return self._apply_weight(grad, hess, weight)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        """ref: binary_objective.hpp:139-160."""
+        if self.weight is not None:
+            suml = float(np.sum((self.label_val > 0) * self.weight))
+            sumw = float(np.sum(self.weight))
+        else:
+            suml = float(self.cnt_pos)
+            sumw = float(self.num_data)
+        pavg = min(max(suml / max(sumw, 1e-300), 1e-10), 1.0 - 1e-10)
+        init = float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+        log.info(f"[{self.name}:BoostFromScore]: pavg={pavg:.6f} -> initscore={init:.6f}")
+        return init
+
+
+# ------------------------------------------------------------------ multiclass
+class MulticlassSoftmax(ObjectiveFunction):
+    """ref: multiclass_objective.hpp:20 MulticlassSoftmax."""
+    name = "multiclass"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_model_per_iteration_ = config.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        li = self.label.astype(np.int32)
+        if (li < 0).any() or (li >= self.num_class).any():
+            log.fatal(f"Label must be in [0, {self.num_class})")
+        self.label_int = li
+        probs = np.zeros(self.num_class)
+        w = self.weight if self.weight is not None else np.ones(num_data)
+        np.add.at(probs, li, w)
+        self.class_init_probs = probs / w.sum()
+        self.factor = self.num_class / (self.num_class - 1.0)
+
+    def get_gradients(self, score, label, weight):
+        """score: [K, n]; returns grad/hess [K, n]."""
+        p = jax.nn.softmax(score, axis=0)
+        onehot = (label.astype(jnp.int32)[None, :]
+                  == jnp.arange(self.num_class)[:, None])
+        grad = p - onehot.astype(p.dtype)
+        hess = self.factor * p * (1.0 - p)
+        if weight is not None:
+            grad = grad * weight[None, :]
+            hess = hess * weight[None, :]
+        return grad, hess
+
+    def convert_output(self, score):
+        return jax.nn.softmax(score, axis=0)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        p = self.class_init_probs[class_id]
+        return float(np.log(p)) if p > 0 else -np.inf
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """ref: multiclass_objective.hpp:130 MulticlassOVA (per-class binary)."""
+    name = "multiclassova"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_model_per_iteration_ = config.num_class
+        self.binary: list[BinaryLogloss] = []
+        for k in range(config.num_class):
+            self.binary.append(BinaryLogloss(
+                config, is_pos=(lambda label, kk=k: label.astype(np.int32) == kk)))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        for b in self.binary:
+            b.init(metadata, num_data)
+
+    def get_gradients(self, score, label, weight):
+        grads, hesses = [], []
+        for k in range(self.num_class):
+            g, h = self.binary[k].get_gradients(score[k], label, weight)
+            grads.append(g)
+            hesses.append(h)
+        return jnp.stack(grads), jnp.stack(hesses)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.binary[0].sigmoid * score))
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self.binary[class_id].boost_from_score()
+
+
+# --------------------------------------------------------------- cross-entropy
+class CrossEntropy(ObjectiveFunction):
+    """Label in [0,1] (ref: xentropy_objective.hpp:29 CrossEntropy)."""
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (self.label < 0).any() or (self.label > 1).any():
+            log.fatal("[cross_entropy]: label must be in [0, 1]")
+
+    def get_gradients(self, score, label, weight):
+        p = 1.0 / (1.0 + jnp.exp(-score))
+        if weight is None:
+            return p - label, p * (1.0 - p)
+        return (p - label) * weight, p * (1.0 - p) * weight
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-score))
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        w = self.weight if self.weight is not None else np.ones_like(self.label)
+        pavg = float(np.sum(self.label * w) / np.sum(w))
+        pavg = min(max(pavg, 1e-10), 1.0 - 1e-10)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """ref: xentropy_objective.hpp:162 CrossEntropyLambda (weights enter via
+    log1p link)."""
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (self.label < 0).any() or (self.label > 1).any():
+            log.fatal("[cross_entropy_lambda]: label must be in [0, 1]")
+
+    def get_gradients(self, score, label, weight):
+        if weight is None:
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            return z - label, z * (1.0 - z)
+        # weighted case (ref: xentropy_objective.hpp:234-250)
+        w, y = weight, label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d2 = c - 1.0
+        b = (c / (d2 * d2)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def convert_output(self, score):
+        return jnp.log1p(jnp.exp(score))
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        w = self.weight if self.weight is not None else np.ones_like(self.label)
+        pavg = float(np.sum(self.label * w) / np.sum(w))
+        pavg = min(max(pavg, 1e-10), 1.0 - 1e-10)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+
+# --------------------------------------------------------------------- factory
+_REGISTRY = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+}
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """ref: src/objective/objective_function.cpp:20 CreateObjectiveFunction."""
+    name = config.objective
+    if name in ("custom", "", "none"):
+        return None
+    if name in ("lambdarank", "rank_xendcg"):
+        from .ranking import create_ranking_objective
+        return create_ranking_objective(name, config)
+    if name not in _REGISTRY:
+        log.fatal(f"Unknown objective type name: {name}")
+    return _REGISTRY[name](config)

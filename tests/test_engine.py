@@ -1,0 +1,194 @@
+"""End-to-end train/eval/predict tests (mirrors the reference's
+tests/python_package_test/test_engine.py strategy: assert on metric quality and
+model round-trips rather than internals)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+RNG = np.random.RandomState(42)
+
+
+def make_regression(n=2000, F=10, noise=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, F)
+    y = (np.sin(X[:, 0] * 5) + 2 * X[:, 1] * X[:, 2] + X[:, 3] ** 2
+         + noise * rng.randn(n))
+    return X, y.astype(np.float64)
+
+
+def make_binary(n=2000, F=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, F)
+    logit = 3 * (X[:, 0] - 0.5) + 2 * X[:, 1] * X[:, 2] - X[:, 3]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-3 * logit))).astype(np.float64)
+    return X, y
+
+
+def test_regression_quality():
+    X, y = make_regression()
+    Xte, yte = make_regression(seed=1)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "learning_rate": 0.1, "verbosity": -1}, train,
+                        num_boost_round=60)
+    pred = booster.predict(Xte)
+    mse = float(np.mean((pred - yte) ** 2))
+    assert mse < 0.05 * float(np.var(yte)), mse
+
+
+def test_regression_train_improves_with_rounds():
+    X, y = make_regression(n=1000)
+    train = lgb.Dataset(X, label=y)
+    p = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b10 = lgb.train(p, train, num_boost_round=10)
+    train2 = lgb.Dataset(X, label=y)
+    b60 = lgb.train(p, train2, num_boost_round=60)
+    m10 = float(np.mean((b10.predict(X) - y) ** 2))
+    m60 = float(np.mean((b60.predict(X) - y) ** 2))
+    assert m60 < m10
+
+
+def test_binary_auc_and_logloss():
+    X, y = make_binary()
+    Xte, yte = make_binary(seed=1)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xte, label=yte)
+    record = {}
+    booster = lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss"],
+                         "num_leaves": 15, "verbosity": -1}, train,
+                        num_boost_round=50, valid_sets=[valid],
+                        callbacks=[lgb.record_evaluation(record)])
+    auc = record["valid_0"]["auc"][-1]
+    assert auc > 0.85, auc
+    prob = booster.predict(Xte)
+    assert prob.min() >= 0 and prob.max() <= 1
+    acc = float(((prob > 0.5) == (yte > 0)).mean())
+    assert acc > 0.75, acc
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = make_regression(n=800)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1}, train, num_boost_round=20)
+    pred1 = booster.predict(X)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    pred2 = loaded.predict(X)
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-5, atol=1e-6)
+    assert loaded.num_trees() == booster.num_trees()
+    # text round-trips exactly through a second save
+    s1 = booster.model_to_string()
+    s2 = loaded.model_to_string()
+    t1 = s1[s1.index("Tree=0"):s1.index("end of trees")]
+    t2 = s2[s2.index("Tree=0"):s2.index("end of trees")]
+    for a, b in zip(t1.splitlines(), t2.splitlines()):
+        if a.startswith(("split_gain", "internal_")):
+            continue  # float formatting of %g fields may differ in last digit
+        assert a == b, (a, b)
+
+
+def test_binary_model_loads_probability(tmp_path):
+    X, y = make_binary(n=600)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "verbosity": -1}, train,
+                        num_boost_round=15)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multiclass():
+    n, F, K = 1500, 10, 3
+    rng = np.random.RandomState(5)
+    X = rng.rand(n, F)
+    y = (X[:, 0] * 3).astype(np.int64) % K
+    train = lgb.Dataset(X, label=y.astype(np.float64))
+    booster = lgb.train({"objective": "multiclass", "num_class": K,
+                         "num_leaves": 15, "verbosity": -1}, train,
+                        num_boost_round=20)
+    prob = booster.predict(X)
+    assert prob.shape == (n, K)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-4)
+    acc = float((prob.argmax(axis=1) == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_early_stopping():
+    X, y = make_binary(n=2000)
+    Xte, yte = make_binary(n=600, seed=9)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xte, label=yte)
+    booster = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "num_leaves": 15, "learning_rate": 0.3,
+                         "verbosity": -1, "early_stopping_round": 5},
+                        train, num_boost_round=150, valid_sets=[valid])
+    assert 0 < booster.best_iteration < 150
+
+
+def test_weights_affect_training():
+    X, y = make_regression(n=800)
+    w = np.ones(len(y))
+    w[:400] = 10.0
+    b1 = lgb.train({"objective": "regression", "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    b2 = lgb.train({"objective": "regression", "verbosity": -1},
+                   lgb.Dataset(X, label=y, weight=w), num_boost_round=10)
+    assert not np.allclose(b1.predict(X), b2.predict(X))
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_regression(n=2000)
+    booster = lgb.train({"objective": "regression", "bagging_fraction": 0.6,
+                         "bagging_freq": 1, "feature_fraction": 0.7,
+                         "num_leaves": 15, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=30)
+    mse = float(np.mean((booster.predict(X) - y) ** 2))
+    assert mse < 0.3 * float(np.var(y))
+
+
+def test_l1_objective_renew():
+    X, y = make_regression(n=800)
+    booster = lgb.train({"objective": "regression_l1", "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=30)
+    mae = float(np.mean(np.abs(booster.predict(X) - y)))
+    base = float(np.mean(np.abs(np.median(y) - y)))
+    assert mae < 0.5 * base
+
+
+def test_custom_objective_fobj():
+    X, y = make_regression(n=600)
+    train = lgb.Dataset(X, label=y)
+
+    def fobj(score, dset):
+        return score - y, np.ones_like(y)
+
+    booster = lgb.train({"objective": "custom", "verbosity": -1}, train,
+                        num_boost_round=20, fobj=fobj)
+    pred = booster.predict(X)  # raw score for custom objective
+    assert float(np.mean((pred - y) ** 2)) < 0.3 * float(np.var(y))
+
+
+def test_feature_importance():
+    X, y = make_regression(n=800)
+    booster = lgb.train({"objective": "regression", "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = booster.feature_importance()
+    assert imp.shape == (X.shape[1],)
+    # informative features 0..3 should dominate
+    assert imp[:4].sum() > imp[4:].sum()
+
+
+def test_predict_leaf_index():
+    X, y = make_regression(n=500)
+    booster = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+    leaves = booster.predict(X, pred_leaf=True)
+    assert leaves.shape == (500, 5)
+    assert leaves.max() < 7
