@@ -1,0 +1,80 @@
+"""Benchmark: sec/iteration on a Higgs-like binary workload (driver contract).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): reference CPU LightGBM trains Higgs (10.5M rows,
+28 features, num_leaves=255, 500 iters) in 130.094 s => 0.260 s/iter
+(docs/Experiments.rst:110-123).  This bench runs the same config shape on a
+synthetic Higgs-like dataset at BENCH_ROWS rows (default 1M; the real Higgs
+file is not downloadable in this environment) and scales the baseline
+linearly in rows for vs_baseline — the reference's histogram cost is linear in
+num_data, so sec_per_iter_baseline ~ 0.260 * rows / 10.5e6.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+FEATURES = 28
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+ITERS = int(os.environ.get("BENCH_ITERS", 10))
+BASELINE_SEC_PER_ITER_10M = 130.094 / 500  # ref docs/Experiments.rst
+HIGGS_ROWS = 10_500_000
+
+
+def make_higgs_like(n, F, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.empty((n, F), dtype=np.float32)
+    # mix of gaussian "low-level" and heavy-tailed "high-level" features
+    for f in range(F):
+        if f % 3 == 0:
+            X[:, f] = rng.randn(n)
+        elif f % 3 == 1:
+            X[:, f] = np.abs(rng.randn(n)) ** 1.5
+        else:
+            X[:, f] = rng.rand(n)
+    w = rng.randn(F) / np.sqrt(F)
+    logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(ROWS, FEATURES)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 20,
+        "verbosity": -1,
+        "metric": "none",
+    }
+    train_set = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(params=params, train_set=train_set)
+
+    # warmup: first iteration compiles the whole-tree program
+    booster.update()
+    t0 = time.time()
+    for _ in range(ITERS):
+        booster.update()
+    # force all device work to finish
+    _ = np.asarray(booster._gbdt.scores[0][:8])
+    elapsed = (time.time() - t0) / ITERS
+
+    baseline = BASELINE_SEC_PER_ITER_10M * ROWS / HIGGS_ROWS
+    print(json.dumps({
+        "metric": f"higgs_like_{ROWS//1000}k_binary_255leaves_sec_per_iter",
+        "value": round(elapsed, 4),
+        "unit": "s/iter",
+        "vs_baseline": round(baseline / elapsed, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

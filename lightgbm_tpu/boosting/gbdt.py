@@ -147,7 +147,8 @@ class GBDT:
                 max_delta_step=config.max_delta_step,
                 path_smooth=config.path_smooth),
             use_hist_stack=stack_bytes <= budget,
-            hist_method="segment")
+            # MXU one-hot matmul wins on TPU; XLA's scatter path wins on CPU
+            hist_method="onehot" if jax.default_backend() == "tpu" else "segment")
 
         # scores [K, n_pad] on device
         K = self.num_tree_per_iteration
@@ -172,11 +173,25 @@ class GBDT:
                 _pad_rows(np.asarray(md.weight, np.float32), self.n_pad)))
             if getattr(objective, "need_train", True) is False:
                 self.class_need_train = [False] * K
+            if not getattr(objective, "run_on_host", False):
+                # one jitted gradient program per training run (the reference
+                # objective loop is a single OMP pass; ours is a single XLA
+                # program, not per-op eager dispatch)
+                self._grad_fn = jax.jit(lambda sc: objective.get_gradients(
+                    sc, self.label_dev, self.weight_dev))
         for m in self.train_metrics:
             m.init(md, n)
         self.init_scores_applied = [0.0] * K
+
+        @jax.jit
+        def _score_update(scores, class_id, leaf_vals, leaf_id, pad_mask):
+            delta = jnp.take(leaf_vals,
+                             jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
+            return scores.at[class_id].add(delta * pad_mask)
+        self._score_update_fn = _score_update
         self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
+        self._ones_col_mask = jnp.ones(len(nb), bool)
         self._bag_mask_host = np.ones(self.n_pad, np.float32)
         self._bag_mask_host[n:] = 0.0
         self.bag_mask = jnp.asarray(self._bag_mask_host)
@@ -228,9 +243,9 @@ class GBDT:
             return grad, hess
         K = self.num_tree_per_iteration
         if K > 1 and obj.num_model_per_iteration() == K:
-            g, h = obj.get_gradients(self.scores, self.label_dev, self.weight_dev)
+            g, h = self._grad_fn(self.scores)
             return g, h
-        g, h = obj.get_gradients(self.scores[0], self.label_dev, self.weight_dev)
+        g, h = self._grad_fn(self.scores[0])
         return g[None, :], h[None, :]
 
     def _update_bagging(self):
@@ -251,7 +266,7 @@ class GBDT:
         cfg = self.config
         F = self.train_data.num_features
         if cfg.feature_fraction >= 1.0:
-            return jnp.ones(F, bool)
+            return self._ones_col_mask
         cnt = max(1, int(round(F * cfg.feature_fraction)))
         mask = np.zeros(F, bool)
         mask[self._rng_feat.choice(F, cnt, replace=False)] = True
@@ -311,6 +326,9 @@ class GBDT:
                        init_score: float) -> Optional[Tree]:
         """Device TreeArrays -> host Tree; renew/shrink/score-update
         (ref: gbdt.cpp:395-407)."""
+        # ONE batched D2H transfer of the whole tree pytree (the CUDA learner
+        # pays one CUDATree::ToHost copy per tree, same idea)
+        arrays = jax.device_get(arrays)
         num_leaves = int(arrays.num_leaves)
         if num_leaves <= 1:
             return None
@@ -363,8 +381,8 @@ class GBDT:
 
         # score update on device (ref: ScoreUpdater::AddScore(tree_learner))
         leaf_vals = jnp.asarray(tree.leaf_value[:max(L, 2)].astype(np.float32))
-        self.scores = self.scores.at[class_id].add(
-            jnp.take(leaf_vals, jnp.clip(leaf_id, 0, max(L, 2) - 1)) * self.pad_mask)
+        self.scores = self._score_update_fn(self.scores, class_id, leaf_vals,
+                                            leaf_id, self.pad_mask)
         # valid scores on host
         for vi, vds in enumerate(self.valid_sets):
             vleaf = leaf_index_bin_space(

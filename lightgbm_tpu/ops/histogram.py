@@ -35,9 +35,15 @@ import jax
 import jax.numpy as jnp
 
 
-def _pick_chunk(n: int, num_features: int, target_elems: int = 1 << 22) -> int:
-    """Row-chunk size: keep F*R around `target_elems`, multiple of 1024."""
-    r = max(1024, target_elems // max(num_features, 1))
+def _pick_chunk(n: int, num_features: int, max_bin: int, method: str) -> int:
+    """Row-chunk size.  For `onehot` the [F, R, B] one-hot materialization is
+    the memory driver (keep it ~64MB); for `segment` the flat id/value copies
+    are (keep F*R around 4M)."""
+    if method == "onehot":
+        r = (64 << 20) // max(num_features * max_bin * 4, 1)
+    else:
+        r = (1 << 22) // max(num_features, 1)
+    r = max(1024, r)
     r = 1 << (int(r) - 1).bit_length()  # next pow2
     return min(r, _round_up(n, 1024))
 
@@ -68,7 +74,7 @@ def _hist_chunk_onehot(binned_c: jnp.ndarray, gh_c: jnp.ndarray,
     onehot = jnp.transpose(onehot, (1, 0, 2)).reshape(rows, num_features * max_bin)
     return jax.lax.dot_general(
         gh_c, onehot, (((0,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST).T              # [F*B, C]
+        precision=jax.lax.Precision.HIGH).T                 # [F*B, C]
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "method", "row_chunk"))
@@ -91,12 +97,14 @@ def build_histogram(binned: jnp.ndarray, gh: jnp.ndarray, mask: jnp.ndarray,
     channels = gh.shape[-1]
     gh = gh * mask.astype(gh.dtype)[:, None]
     total = num_features * max_bin
-    chunk = row_chunk or _pick_chunk(n, num_features)
+    chunk = row_chunk or _pick_chunk(n, num_features, max_bin, method)
     kernel = _hist_chunk_segment if method == "segment" else _hist_chunk_onehot
     if n <= chunk:
         out = kernel(binned, gh, total, max_bin)
         return out.reshape(num_features, max_bin, channels)
 
+    while n % chunk != 0 and chunk > 1024:
+        chunk //= 2  # n is padded to a 1024 multiple; shrink to a divisor
     if n % chunk != 0:
         raise ValueError(f"num_data {n} must be padded to a multiple of {chunk}")
     num_chunks = n // chunk

@@ -1,0 +1,14 @@
+"""Distributed training over jax.sharding meshes.
+
+TPU-native replacement for src/network/ (socket/MPI collectives, ref:
+network.h:89-275) and the three parallel tree learners (ref:
+feature_parallel_tree_learner.cpp, data_parallel_tree_learner.cpp,
+voting_parallel_tree_learner.cpp): instead of hand-rolled Bruck allgather /
+recursive-halving reduce-scatter over TCP, rows are sharded over a mesh axis
+and XLA inserts the psum/all_gather collectives over ICI/DCN.
+"""
+
+from .data_parallel import (data_parallel_shardings, make_mesh,
+                            shard_for_data_parallel)
+
+__all__ = ["data_parallel_shardings", "make_mesh", "shard_for_data_parallel"]

@@ -1,0 +1,69 @@
+"""Data-parallel GBDT: rows sharded over a 1-D mesh (ref: SURVEY.md §2.3 #3).
+
+Mapping from the reference's DataParallelTreeLearner
+(ref: src/treelearner/data_parallel_tree_learner.cpp):
+
+  reference (socket collectives)              TPU (XLA collectives over mesh)
+  ------------------------------------------- -------------------------------
+  rows pre-partitioned per machine            binned [F, n] sharded on axis n
+  local histograms then Network::ReduceScatter  histogram = reduction over the
+    + HistogramSumReducer (:284)                sharded row axis -> GSPMD psum
+  SyncUpGlobalBestSplit allreduce of           best-split argmax runs on the
+    serialized SplitInfo (:441)                 replicated [F,B,2] histogram:
+                                                no explicit sync needed
+  root sums Allreduce in BeforeTrain (:167)    jnp.sum over sharded axis
+  global_data_count_in_leaf_ tracking (:450)   actual counts psum'd the same way
+
+Because `grow_tree` touches sharded data only through row-axis reductions
+(histograms, sums, counts) and row-wise maps (recoloring), annotating the row
+axis is sufficient: XLA partitions the program SPMD and the collectives ride
+ICI — there is no separate "distributed learner" class, which is the point of
+the redesign.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices=None) -> Mesh:
+    """1-D data-parallel mesh (multi-axis meshes come with feature-parallel)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            # fall back to the virtual CPU devices (multi-chip dry-run model)
+            try:
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                pass
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise RuntimeError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def data_parallel_shardings(mesh: Mesh) -> Tuple:
+    """(binned, per-row vectors, replicated) shardings for grow_tree args."""
+    row = NamedSharding(mesh, P(DATA_AXIS))
+    feat_by_row = NamedSharding(mesh, P(None, DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    return feat_by_row, row, repl
+
+
+def shard_for_data_parallel(mesh: Mesh, binned, grad, hess, row_mask):
+    """Place the per-row tensors on the mesh; n must divide the mesh size."""
+    feat_by_row, row, _ = data_parallel_shardings(mesh)
+    return (jax.device_put(binned, feat_by_row),
+            jax.device_put(grad, row),
+            jax.device_put(hess, row),
+            jax.device_put(row_mask, row))

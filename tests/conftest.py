@@ -8,9 +8,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-# persistent XLA compilation cache: grow_tree compiles (~20-60s each on CPU)
-# are reused across pytest runs
 import jax
 
+# the axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend explicitly
+# so tests are fast (no tunnel round-trips) and deterministic
+jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compilation cache: grow_tree compiles (~20-60s each on CPU)
+# are reused across pytest runs
 jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
