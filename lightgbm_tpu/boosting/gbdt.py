@@ -30,6 +30,25 @@ K_EPSILON = 1e-15
 _PAD = 1024  # row padding multiple (histogram chunking requirement)
 
 
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_sample(grad, hess, pad_mask, key, top_k, other_k):
+    """Gradient one-side sampling on device (ref: goss.hpp:118-165):
+    keep the top_k rows by sum_k |g*h|, Bernoulli-sample ~other_k of the rest
+    and amplify them by (n_kept_pool)/other_k."""
+    imp = jnp.sum(jnp.abs(grad * hess), axis=0) * pad_mask
+    thr = jax.lax.top_k(imp, top_k)[0][-1]
+    is_top = (imp >= thr) & (pad_mask > 0)
+    n_real = jnp.sum(pad_mask)
+    rest = n_real - jnp.sum(is_top.astype(jnp.float32))
+    prob = other_k / jnp.maximum(rest, 1.0)
+    sampled = ((jax.random.uniform(key, imp.shape) < prob)
+               & ~is_top & (pad_mask > 0))
+    multiply = rest / other_k
+    scale = jnp.where(sampled, multiply, 1.0)
+    keep = (is_top | sampled).astype(grad.dtype)
+    return keep, grad * scale[None, :], hess * scale[None, :]
+
+
 def _pad_rows(arr: np.ndarray, n_pad: int, axis: int = -1, fill=0):
     n = arr.shape[axis]
     if n == n_pad:
@@ -248,19 +267,43 @@ class GBDT:
         g, h = self._grad_fn(self.scores[0])
         return g[None, :], h[None, :]
 
-    def _update_bagging(self):
-        """Row-mask bagging (ref: src/boosting/bagging.hpp)."""
+    def _update_bagging(self, grad=None, hess=None):
+        """Row sampling per iteration.  Bagging is a row mask (ref:
+        src/boosting/bagging.hpp); GOSS also rescales small-gradient rows
+        (ref: src/boosting/goss.hpp:118-165 Helper).  Returns
+        (bag_mask, grad, hess)."""
         cfg = self.config
         n = self.num_data
+        if cfg.data_sample_strategy == "goss" and grad is not None:
+            # not subsampled for the first 1/learning_rate iterations
+            if self.iter_ < int(1.0 / max(cfg.learning_rate, 1e-10)):
+                return self.bag_mask, grad, hess
+            top_k = max(1, int(n * cfg.top_rate))
+            other_k = max(1, int(n * cfg.other_rate))
+            key = jax.random.PRNGKey(cfg.bagging_seed + self.iter_)
+            mask, grad, hess = _goss_sample(
+                grad, hess, self.pad_mask, key, top_k, other_k)
+            return mask, grad, hess
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
             if self.iter_ % cfg.bagging_freq == 0:
-                cnt = int(n * cfg.bagging_fraction)
-                mask = np.zeros(self.n_pad, np.float32)
-                idx = self._rng_bag.choice(n, cnt, replace=False)
-                mask[idx] = 1.0
+                pos_frac, neg_frac = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
+                if (pos_frac < 1.0 or neg_frac < 1.0) and self.objective is not None \
+                        and self.objective.name == "binary":
+                    # balanced bagging (ref: bagging.hpp balanced_bagging_)
+                    lab = np.asarray(self.train_data.metadata.label) > 0
+                    mask = np.zeros(self.n_pad, np.float32)
+                    for cls_mask, frac in ((lab, pos_frac), (~lab, neg_frac)):
+                        cls_idx = np.nonzero(cls_mask)[0]
+                        take = int(len(cls_idx) * frac)
+                        mask[self._rng_bag.choice(cls_idx, take, replace=False)] = 1.0
+                else:
+                    cnt = int(n * cfg.bagging_fraction)
+                    mask = np.zeros(self.n_pad, np.float32)
+                    idx = self._rng_bag.choice(n, cnt, replace=False)
+                    mask[idx] = 1.0
                 self._bag_mask_host = mask
                 self.bag_mask = jnp.asarray(mask)
-        return self.bag_mask
+        return self.bag_mask, grad, hess
 
     def _col_mask(self):
         cfg = self.config
@@ -287,7 +330,7 @@ class GBDT:
             hess = jnp.asarray(_pad_rows(np.asarray(hessians, np.float32)
                                          .reshape(K, -1), self.n_pad))
 
-        bag_mask = self._update_bagging()
+        bag_mask, grad, hess = self._update_bagging(grad, hess)
         should_continue = False
         for k in range(K):
             tree = None
