@@ -209,6 +209,7 @@ class Booster:
         if fobj is not None:
             K = self._gbdt.num_tree_per_iteration
             n = self._gbdt.num_data
+            self._gbdt.pre_gradient_hook()
             score = self.__inner_predict_train()
             grad, hess = fobj(score if K == 1 else score.T, self._train_set)
             grad = np.asarray(grad, np.float32)

@@ -10,6 +10,7 @@ raw-feature prediction.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -87,9 +88,12 @@ def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
 class GBDT:
     """ref: src/boosting/gbdt.cpp GBDT."""
 
+    average_output_ = False  # RF overrides (ref: gbdt.h average_output_)
+
     def __init__(self):
         self.models_: List[Tree] = []
         self.iter_ = 0
+        self.num_init_iteration_ = 0
         self.config: Optional[Config] = None
         self.train_data: Optional[Dataset] = None
         self.objective: Optional[ObjectiveFunction] = None
@@ -215,6 +219,58 @@ class GBDT:
         self._bag_mask_host[n:] = 0.0
         self.bag_mask = jnp.asarray(self._bag_mask_host)
 
+    def _raw_or_reconstruct(self, ds: Dataset) -> np.ndarray:
+        """Raw feature matrix for prediction: the kept raw data when present,
+        else representative bin values (exact for trees trained with the same
+        bin mappers, since numerical thresholds are bin upper bounds)."""
+        if ds.raw_data is not None:
+            return ds.raw_data
+        from ..io.binning import MISSING_NAN, MISSING_ZERO
+        X = np.zeros((ds.num_data, ds.num_total_features))
+        for i, f in enumerate(ds.used_features):
+            m = ds.bin_mappers[f]
+            lut = np.array([m.bin_to_value(b) for b in range(m.num_bin)])
+            # missing-value bins must reconstruct to the value the predictor's
+            # default_left routing expects, not the bin's upper bound
+            if m.missing_type == MISSING_NAN:
+                lut[m.num_bin - 1] = np.nan
+            elif m.missing_type == MISSING_ZERO:
+                lut[m.default_bin] = 0.0
+            X[:, f] = lut[np.clip(ds.binned[i], 0, m.num_bin - 1)]
+        return X
+
+    def continue_from(self, prev: "GBDT", train_raw=None,
+                      valid_raws=None) -> None:
+        """Continued training: adopt prev's trees and seed train/valid scores
+        with its predictions (ref: application.cpp:94-97 init score from
+        input_model; gbdt.h:70 MergeFrom)."""
+        import copy as _copy
+        K = self.num_tree_per_iteration
+        if prev.num_tree_per_iteration != K:
+            log.fatal("Cannot continue training: the initial model has "
+                      f"{prev.num_tree_per_iteration} trees per iteration, "
+                      f"this one needs {K}")
+        if getattr(prev, "average_output_", False) != self.average_output_:
+            log.fatal("Cannot continue training across averaging modes "
+                      "(rf vs gbdt/dart): tree outputs would be combined "
+                      "with the wrong weights")
+        self.models_ = [_copy.deepcopy(t) for t in prev.models_]
+        self.num_init_iteration_ = len(self.models_) // max(K, 1)
+        self.iter_ = 0
+        X = (train_raw if train_raw is not None
+             else self._raw_or_reconstruct(self.train_data))
+        raw = prev.predict_raw(np.asarray(X, np.float64))
+        raw = raw[:, None] if raw.ndim == 1 else raw  # [n, K]
+        self.scores = self.scores + jnp.asarray(
+            _pad_rows(raw.T.astype(np.float32), self.n_pad))
+        for vi, vds in enumerate(self.valid_sets):
+            vX = (valid_raws[vi] if valid_raws is not None
+                  and valid_raws[vi] is not None
+                  else self._raw_or_reconstruct(vds))
+            vraw = prev.predict_raw(np.asarray(vX, np.float64))
+            vraw = vraw[:, None] if vraw.ndim == 1 else vraw
+            self.valid_scores[vi] += vraw.T
+
     def add_valid_data(self, valid_data: Dataset, name: str,
                        metrics: Sequence[Metric]) -> None:
         self.valid_sets.append(valid_data)
@@ -315,6 +371,11 @@ class GBDT:
         mask[self._rng_feat.choice(F, cnt, replace=False)] = True
         return jnp.asarray(mask)
 
+    def pre_gradient_hook(self) -> None:
+        """Called before training scores are read for gradient computation
+        (custom fobj path).  DART drops trees here so the user's objective
+        sees the dropped ensemble (ref: dart.hpp:77 GetTrainingScore)."""
+
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (ref: gbdt.cpp:338 TrainOneIter)."""
@@ -365,12 +426,10 @@ class GBDT:
         self.iter_ += 1
         return False
 
-    def _finalize_tree(self, arrays, leaf_id, class_id: int,
-                       init_score: float) -> Optional[Tree]:
-        """Device TreeArrays -> host Tree; renew/shrink/score-update
-        (ref: gbdt.cpp:395-407)."""
-        # ONE batched D2H transfer of the whole tree pytree (the CUDA learner
-        # pays one CUDATree::ToHost copy per tree, same idea)
+    def _arrays_to_tree(self, arrays) -> Optional[Tree]:
+        """Device TreeArrays -> host Tree (pure conversion; one batched D2H
+        transfer of the whole tree pytree, like CUDATree::ToHost,
+        ref: src/io/cuda/cuda_tree.cpp)."""
         arrays = jax.device_get(arrays)
         num_leaves = int(arrays.num_leaves)
         if num_leaves <= 1:
@@ -407,6 +466,17 @@ class GBDT:
         tree.leaf_count[:nl] = np.asarray(arrays.leaf_count)[:nl]
         tree.leaf_parent[:nl] = np.asarray(arrays.leaf_parent)[:nl]
         tree.leaf_depth[:nl] = np.asarray(arrays.leaf_depth)[:nl]
+        return tree
+
+    def _finalize_tree(self, arrays, leaf_id, class_id: int,
+                       init_score: float) -> Optional[Tree]:
+        """Host Tree + renew/shrink/score-update (ref: gbdt.cpp:395-407)."""
+        tree = self._arrays_to_tree(arrays)
+        if tree is None:
+            return None
+        num_leaves = tree.num_leaves
+        nl = num_leaves
+        L = self.config.num_leaves
 
         # per-leaf output renewal (ref: RenewTreeOutput; L1/quantile/MAPE)
         obj = self.objective
@@ -427,17 +497,40 @@ class GBDT:
         self.scores = self._score_update_fn(self.scores, class_id, leaf_vals,
                                             leaf_id, self.pad_mask)
         # valid scores on host
-        for vi, vds in enumerate(self.valid_sets):
-            vleaf = leaf_index_bin_space(
-                sf_inner, thr_bin, dleft,
-                tree.left_child[:ni], tree.right_child[:ni], num_leaves,
-                self.f_missing_type, self.f_num_bin, self.f_default_bin,
-                vds.binned)
-            self.valid_scores[vi][class_id] += tree.leaf_value[vleaf]
+        self._add_tree_score(tree, class_id, train=False)
 
         if abs(init_score) > K_EPSILON:
             tree.add_bias(init_score)
         return tree
+
+    # -------------------------------------------------------- score plumbing
+    def _tree_leaf_ids(self, tree: Tree, binned: np.ndarray) -> np.ndarray:
+        """Bin-space leaf index of every row for a tree trained on this
+        dataset's bin mappers."""
+        ni = tree.num_leaves - 1
+        return leaf_index_bin_space(
+            tree.split_feature_inner[:ni], tree.threshold_in_bin[:ni],
+            (tree.decision_type[:ni] & 2) > 0,
+            tree.left_child[:ni], tree.right_child[:ni], tree.num_leaves,
+            self.f_missing_type, self.f_num_bin, self.f_default_bin, binned)
+
+    def _add_tree_score(self, tree: Tree, class_id: int,
+                        train: bool = True, valid: bool = True) -> None:
+        """score += tree's *current* leaf outputs (ref: score_updater.hpp:21
+        AddScore; used by DART drop/normalize and RF averaging)."""
+        if train:
+            ids = self._tree_leaf_ids(tree, self.train_data.binned)
+            # fixed-size leaf_vals so _score_update_fn compiles once
+            L = max(self.config.num_leaves, 2)
+            vals = np.zeros(L, np.float32)
+            vals[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+            self.scores = self._score_update_fn(
+                self.scores, class_id, jnp.asarray(vals),
+                jnp.asarray(_pad_rows(ids, self.n_pad)), self.pad_mask)
+        if valid:
+            for vi, vds in enumerate(self.valid_sets):
+                vids = self._tree_leaf_ids(tree, vds.binned)
+                self.valid_scores[vi][class_id] += tree.leaf_value[vids]
 
     # ------------------------------------------------------------------- eval
     def eval_train(self):
@@ -470,6 +563,8 @@ class GBDT:
         for it in range(start_iteration, end):
             for k in range(K):
                 out[k] += self.models_[it * K + k].predict(X)
+        if self.average_output_ and end > start_iteration:
+            out /= end - start_iteration  # ref: gbdt_prediction.cpp:57
         return out[0] if K == 1 else out.T
 
     def predict(self, X: np.ndarray, raw_score: bool = False,

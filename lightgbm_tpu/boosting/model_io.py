@@ -76,16 +76,21 @@ def save_model_to_string(booster, num_iteration: int = -1,
         "feature_names=" + " ".join(feature_names),
         "feature_infos=" + " ".join(feature_infos),
     ]
+    if getattr(booster, "average_output_", False):
+        lines.append("average_output")  # ref: gbdt_model_text.cpp:330-331
     tree_blocks = []
     for it in range(start_iteration, end):
         for k in range(K):
             idx = it * K + k
             tree_blocks.append(booster.models_[idx].to_string(len(tree_blocks)))
+    # each block is "Tree=N\n...\n\n"; tree_sizes are the exact byte lengths of
+    # the blocks as written, concatenated with no separator, so the reference
+    # loader can seek by cumulative offsets (ref: gbdt_model_text.cpp:355-372)
     lines.append("tree_sizes=" + " ".join(str(len(b)) for b in tree_blocks))
     lines.append("")
     out = "\n".join(lines) + "\n"
-    out += "\n".join(tree_blocks)
-    out += "\nend of trees\n"
+    out += "".join(tree_blocks)
+    out += "end of trees\n"
 
     imp = booster.feature_importance(importance_type)
     order = np.argsort(-imp, kind="stable")
@@ -118,6 +123,8 @@ def load_model_from_string(text: str):
         if "=" in line:
             k, v = line.split("=", 1)
             kv[k.strip()] = v.strip()
+        elif line.strip() == "average_output":
+            booster.average_output_ = True  # ref: gbdt_model_text.cpp:487
     if "version" not in kv:
         log.warning("Unknown model format version")
     num_class = int(kv.get("num_class", "1"))

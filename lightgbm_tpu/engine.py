@@ -24,14 +24,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     """ref: engine.py:66 train."""
     params = dict(params or {})
     cfg = Config(params)
-    if cfg.num_iterations != 100 and num_boost_round == 100:
+    # an explicitly-passed num_iterations (or alias) wins over the function
+    # default, matching the reference alias resolution (ref: engine.py:145-152)
+    if "num_iterations" in cfg.raw_params:
         num_boost_round = cfg.num_iterations
-
-    if init_model is not None:
-        log.fatal("init_model (continued training) is not yet supported")
 
     booster = Booster(params=params, train_set=train_set)
     train_in_valid = False
+    valid_wrappers: List[Dataset] = []
     if valid_sets:
         for i, vs in enumerate(valid_sets):
             if vs is train_set:
@@ -40,6 +40,29 @@ def train(params: Dict[str, Any], train_set: Dataset,
             name = (valid_names[i] if valid_names and i < len(valid_names)
                     else f"valid_{i}")
             booster.add_valid(vs, name)
+            valid_wrappers.append(vs)
+
+    if init_model is not None:
+        # continued training (ref: engine.py init_model -> _InnerPredictor;
+        # the previous model's trees are adopted and its predictions seed the
+        # scores, so the returned booster contains old + new trees)
+        import os
+        if isinstance(init_model, Booster):
+            prev = init_model
+        elif isinstance(init_model, (str, bytes, os.PathLike)):
+            prev = Booster(model_file=os.fspath(init_model))
+        else:
+            log.fatal(f"Unknown init_model type: {type(init_model)}")
+
+        def _raw_of(ds):
+            d = getattr(ds, "data", None)
+            if d is None or isinstance(d, (str, bytes)):
+                return None
+            return d.values if hasattr(d, "values") else np.asarray(d)
+
+        booster._gbdt.continue_from(
+            prev._gbdt, train_raw=_raw_of(train_set),
+            valid_raws=[_raw_of(vs) for vs in valid_wrappers])
 
     callbacks = list(callbacks or [])
     if cfg.early_stopping_round > 0 and valid_sets:
@@ -97,7 +120,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config(params)
-    if cfg.num_iterations != 100 and num_boost_round == 100:
+    if "num_iterations" in cfg.raw_params:
         num_boost_round = cfg.num_iterations
     core = train_set._core_or_construct()
     n = core.num_data

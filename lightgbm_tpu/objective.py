@@ -368,7 +368,7 @@ class BinaryLogloss(ObjectiveFunction):
     def boost_from_score(self, class_id: int = 0) -> float:
         """ref: binary_objective.hpp:139-160."""
         if self.weight is not None:
-            suml = float(np.sum((self.label_val > 0) * self.weight))
+            suml = float(np.sum(self.is_pos(self.label) * self.weight))
             sumw = float(np.sum(self.weight))
         else:
             suml = float(self.cnt_pos)

@@ -1,0 +1,296 @@
+"""DART, RF, GOSS, lambdarank and continued-training e2e tests (mirrors
+reference tests/python_package_test/test_engine.py: test_dart, test_rf,
+test_goss, rank fixtures, test_continue_train)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_binary(n=2000, F=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, F)
+    logit = 3 * (X[:, 0] - 0.5) + 2 * X[:, 1] * X[:, 2] - X[:, 3]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-3 * logit))).astype(np.float64)
+    return X, y
+
+
+def make_regression(n=2000, F=10, noise=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, F)
+    y = (np.sin(X[:, 0] * 5) + 2 * X[:, 1] * X[:, 2] + X[:, 3] ** 2
+         + noise * rng.randn(n))
+    return X, y.astype(np.float64)
+
+
+def make_ranking(n_queries=60, docs_per_query=20, F=8, seed=3):
+    """Synthetic learning-to-rank data with graded relevance labels."""
+    rng = np.random.RandomState(seed)
+    n = n_queries * docs_per_query
+    X = rng.rand(n, F)
+    rel_score = 2.5 * X[:, 0] + 1.5 * X[:, 1] - X[:, 2] + 0.3 * rng.randn(n)
+    y = np.zeros(n)
+    for q in range(n_queries):
+        s = slice(q * docs_per_query, (q + 1) * docs_per_query)
+        r = rel_score[s]
+        y[s] = np.digitize(r, np.quantile(r, [0.5, 0.75, 0.9]))
+    group = np.full(n_queries, docs_per_query)
+    return X, y, group
+
+
+def _auc(y, score):
+    order = np.argsort(score)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+# ------------------------------------------------------------------- DART
+def test_dart_trains_and_beats_chance():
+    X, y = make_binary()
+    Xte, yte = make_binary(seed=1)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "boosting": "dart",
+                         "num_leaves": 15, "learning_rate": 0.15,
+                         "drop_rate": 0.3, "verbosity": -1},
+                        train, num_boost_round=40)
+    assert booster.num_trees() == 40
+    auc = _auc(yte, booster.predict(Xte))
+    assert auc > 0.8, auc
+
+
+def test_dart_train_score_consistent_with_model():
+    """After normalization, the device training score must equal the summed
+    tree predictions (the invariant DART's drop/normalize dance maintains)."""
+    X, y = make_regression(n=500, F=5)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "regression", "boosting": "dart",
+                         "num_leaves": 7, "drop_rate": 0.5, "skip_drop": 0.0,
+                         "verbosity": -1}, train, num_boost_round=15)
+    gbdt = booster._gbdt
+    internal = np.asarray(gbdt.scores)[0][:gbdt.num_data]
+    from_model = booster.predict(X, raw_score=True)
+    np.testing.assert_allclose(internal, from_model, rtol=1e-4, atol=1e-4)
+
+
+def test_dart_uniform_and_xgboost_modes():
+    X, y = make_binary(n=800)
+    for extra in ({"uniform_drop": True}, {"xgboost_dart_mode": True}):
+        train = lgb.Dataset(X, label=y)
+        booster = lgb.train({"objective": "binary", "boosting": "dart",
+                             "num_leaves": 7, "verbosity": -1, **extra},
+                            train, num_boost_round=10)
+        assert booster.num_trees() == 10
+
+
+# --------------------------------------------------------------------- RF
+def test_rf_trains_and_beats_chance():
+    X, y = make_binary()
+    Xte, yte = make_binary(seed=1)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "boosting": "rf",
+                         "bagging_freq": 1, "bagging_fraction": 0.7,
+                         "feature_fraction": 0.8, "num_leaves": 31,
+                         "verbosity": -1}, train, num_boost_round=30)
+    auc = _auc(yte, booster.predict(Xte))
+    assert auc > 0.8, auc
+
+
+def test_rf_prediction_is_average(tmp_path):
+    """RF predictions average tree outputs; model file carries
+    average_output (ref: gbdt_model_text.cpp:330)."""
+    X, y = make_regression(n=600, F=5)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "regression", "boosting": "rf",
+                         "bagging_freq": 1, "bagging_fraction": 0.6,
+                         "num_leaves": 15, "verbosity": -1},
+                        train, num_boost_round=20)
+    # averaged output stays on the scale of y, and matches the running
+    # average the internal score tracker maintains
+    pred = booster.predict(X)
+    gbdt = booster._gbdt
+    internal = np.asarray(gbdt.scores)[0][:gbdt.num_data]
+    np.testing.assert_allclose(internal, pred, rtol=1e-4, atol=1e-4)
+    txt = booster.model_to_string()
+    assert "average_output" in txt
+    path = str(tmp_path / "rf.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), pred, rtol=1e-5, atol=1e-5)
+
+
+def test_rf_requires_bagging():
+    import pytest
+    X, y = make_binary(n=300)
+    train = lgb.Dataset(X, label=y)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "rf",
+                   "verbosity": -1}, train, num_boost_round=3)
+
+
+# ------------------------------------------------------------------- GOSS
+def test_goss_quality():
+    X, y = make_binary(n=4000)
+    Xte, yte = make_binary(seed=1)
+    train = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary",
+                         "data_sample_strategy": "goss",
+                         "top_rate": 0.2, "other_rate": 0.1,
+                         "num_leaves": 15, "learning_rate": 0.1,
+                         "verbosity": -1}, train, num_boost_round=50)
+    auc = _auc(yte, booster.predict(Xte))
+    assert auc > 0.85, auc
+
+
+def test_goss_sample_math():
+    """Mask keeps ~top_rate+other_rate of rows; small-gradient rows are
+    amplified by rest/other_k (ref: goss.hpp:118-165)."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.boosting.gbdt import _goss_sample
+
+    n = 1000
+    rng = np.random.RandomState(0)
+    grad = jnp.asarray(rng.randn(1, n).astype(np.float32))
+    hess = jnp.ones((1, n), jnp.float32)
+    pad_mask = jnp.ones(n, jnp.float32)
+    top_k, other_k = 200, 100
+    keep, g2, h2 = _goss_sample(grad, hess, pad_mask, jax.random.PRNGKey(0),
+                                top_k, other_k)
+    kept = int(np.asarray(keep).sum())
+    assert abs(kept - (top_k + other_k)) < 60, kept
+    # top rows keep their gradient unchanged
+    imp = np.abs(np.asarray(grad[0]))
+    top_idx = np.argsort(-imp)[:top_k]
+    np.testing.assert_allclose(np.asarray(g2)[0][top_idx],
+                               np.asarray(grad)[0][top_idx], rtol=1e-6)
+    # sampled small-gradient rows are amplified
+    amplified = np.asarray(g2)[0] / np.where(np.asarray(grad)[0] == 0, 1,
+                                             np.asarray(grad)[0])
+    small_kept = (np.asarray(keep) > 0) & ~np.isin(np.arange(n), top_idx)
+    if small_kept.any():
+        assert np.all(amplified[small_kept] > 1.0)
+
+
+# ------------------------------------------------------------- lambdarank
+def test_lambdarank_ndcg_improves():
+    X, y, group = make_ranking()
+    Xte, yte, gte = make_ranking(seed=7)
+    train = lgb.Dataset(X, label=y, group=group)
+    valid = train.create_valid(Xte, label=yte, group=gte)
+    record = {}
+    booster = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "ndcg_eval_at": [5], "num_leaves": 15,
+                         "learning_rate": 0.1, "verbosity": -1},
+                        train, num_boost_round=50, valid_sets=[valid],
+                        callbacks=[lgb.record_evaluation(record)])
+    curve = record["valid_0"]["ndcg@5"]
+    assert curve[-1] > curve[0] + 0.02, curve[:3] + curve[-3:]
+    assert curve[-1] > 0.8, curve[-1]
+
+
+def test_rank_xendcg_trains():
+    X, y, group = make_ranking(n_queries=40)
+    train = lgb.Dataset(X, label=y, group=group)
+    booster = lgb.train({"objective": "rank_xendcg", "num_leaves": 7,
+                         "verbosity": -1}, train, num_boost_round=15)
+    assert booster.num_trees() == 15
+
+
+# ------------------------------------------------- continued training
+def test_continued_training_matches_single_run(tmp_path):
+    """train 10 + save + load + train 10 more ≈ train 20 (ref:
+    test_engine.py test_continue_train; application.cpp:94-97)."""
+    X, y = make_regression(n=1500)
+    p = {"objective": "regression", "num_leaves": 15,
+         "learning_rate": 0.1, "verbosity": -1}
+
+    b20 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=20)
+
+    b10 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "m10.txt")
+    b10.save_model(path)
+    b_cont = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10,
+                       init_model=path)
+    assert b_cont.num_trees() == 20
+    np.testing.assert_allclose(b_cont.predict(X), b20.predict(X),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_continued_training_from_booster():
+    X, y = make_binary(n=1200)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b10 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10)
+    b_cont = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10,
+                       init_model=b10)
+    b20 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=20)
+    np.testing.assert_allclose(b_cont.predict(X), b20.predict(X),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rf_continued_training_scores_consistent():
+    """Continuing an RF from an RF keeps the internal running-average score
+    equal to the merged model's own (averaged) prediction."""
+    X, y = make_regression(n=800, F=5)
+    p = {"objective": "regression", "boosting": "rf", "bagging_freq": 1,
+         "bagging_fraction": 0.6, "num_leaves": 15, "verbosity": -1}
+    b5 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+    b_cont = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5,
+                       init_model=b5)
+    gbdt = b_cont._gbdt
+    internal = np.asarray(gbdt.scores)[0][:gbdt.num_data]
+    np.testing.assert_allclose(internal, b_cont.predict(X),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_continue_across_averaging_modes_rejected():
+    import pytest
+    X, y = make_regression(n=300, F=5)
+    prf = {"objective": "regression", "boosting": "rf", "bagging_freq": 1,
+           "bagging_fraction": 0.6, "num_leaves": 7, "verbosity": -1}
+    brf = lgb.train(prf, lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression", "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3, init_model=brf)
+
+
+def test_dart_custom_objective_sees_dropped_score():
+    """With fobj, the score handed to the objective reflects this iteration's
+    dropout (ref: dart.hpp GetTrainingScore)."""
+    X, y = make_regression(n=400, F=5)
+    train = lgb.Dataset(X, label=y)
+    seen_scores = []
+
+    def fobj(score, _ds):
+        seen_scores.append(np.array(score, copy=True))
+        g = score - y
+        h = np.ones_like(score)
+        return g, h
+
+    booster = lgb.Booster(params={"objective": "none", "boosting": "dart",
+                                  "num_leaves": 7, "drop_rate": 1.0,
+                                  "skip_drop": 0.0, "verbosity": -1},
+                          train_set=train)
+    booster.update(fobj=fobj)
+    gbdt = booster._gbdt
+    # after iter 1 normalization, internal score == ensemble prediction
+    internal = np.asarray(gbdt.scores)[0][:gbdt.num_data]
+    booster.update(fobj=fobj)
+    # with drop_rate=1/skip_drop=0 every tree is dropped, so the score the
+    # second fobj saw must differ from the post-normalization ensemble score
+    assert not np.allclose(seen_scores[1], internal)
+
+
+def test_num_boost_round_alias_precedence():
+    """Explicit num_boost_round arg is honored unless num_iterations was
+    explicitly passed in params (reference alias precedence)."""
+    X, y = make_regression(n=400, F=5)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_iterations": 5}, lgb.Dataset(X, label=y),
+                  num_boost_round=100)
+    assert b.num_trees() == 5
+    b2 = lgb.train({"objective": "regression", "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=7)
+    assert b2.num_trees() == 7
