@@ -36,21 +36,35 @@ def make_higgs_like(n, F, seed=0):
             X[:, f] = np.abs(rng.randn(n)) ** 1.5
         else:
             X[:, f] = rng.rand(n)
-    w = rng.randn(F) / np.sqrt(F)
+    # the label function is FIXED across seeds so train/test share it
+    w = np.random.RandomState(1234).randn(F) / np.sqrt(F)
     logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
     y = (rng.rand(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
     return X, y
+
+
+def _auc(y, s):
+    """Tie-averaged rank-sum AUC (ties get 0.5 credit per pos/neg pair, as
+    binary_metric.hpp's AUCMetric does via equal-score blocks)."""
+    _, inv, counts = np.unique(s, return_inverse=True, return_counts=True)
+    cum = np.cumsum(counts) - counts
+    ranks = (cum + (counts + 1) / 2.0)[inv]
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2)
+                 / max(n_pos * n_neg, 1))
 
 
 def main():
     import lightgbm_tpu as lgb
 
     X, y = make_higgs_like(ROWS, FEATURES)
+    Xte, yte = make_higgs_like(100_000, FEATURES, seed=1)
     params = {
         "objective": "binary",
         "num_leaves": NUM_LEAVES,
         "learning_rate": 0.1,
-        "max_bin": 255,
+        "max_bin": int(os.environ.get("BENCH_BINS", 255)),
         "min_data_in_leaf": 20,
         "verbosity": -1,
         "metric": "none",
@@ -67,12 +81,18 @@ def main():
     _ = np.asarray(booster._gbdt.scores[0][:8])
     elapsed = (time.time() - t0) / ITERS
 
+    # quality gate: held-out AUC after the timed iterations (speed must not
+    # be bought with broken trees)
+    auc = _auc(yte, booster._gbdt.predict_raw(Xte))
+
     baseline = BASELINE_SEC_PER_ITER_10M * ROWS / HIGGS_ROWS
     print(json.dumps({
         "metric": f"higgs_like_{ROWS//1000}k_binary_255leaves_sec_per_iter",
         "value": round(elapsed, 4),
         "unit": "s/iter",
         "vs_baseline": round(baseline / elapsed, 4),
+        "auc": round(auc, 5),
+        "iters": ITERS + 1,
     }))
 
 

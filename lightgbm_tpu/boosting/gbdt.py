@@ -170,8 +170,13 @@ class GBDT:
                 max_delta_step=config.max_delta_step,
                 path_smooth=config.path_smooth),
             use_hist_stack=stack_bytes <= budget,
-            # MXU one-hot matmul wins on TPU; XLA's scatter path wins on CPU
-            hist_method="onehot" if jax.default_backend() == "tpu" else "segment")
+            # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
+            # VMEM, like the CUDA shared-memory histogram kernels); XLA's
+            # scatter path wins on CPU.  Both accumulate fp32; gpu_use_dp
+            # selects the 3-pass high-precision matmul fallback instead
+            # (ref: gpu_tree_learner.h:79 single-precision default).
+            hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
+                         if jax.default_backend() == "tpu" else "segment"))
 
         # scores [K, n_pad] on device
         K = self.num_tree_per_iteration

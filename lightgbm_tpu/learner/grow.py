@@ -8,9 +8,13 @@ Design differences from the reference, chosen for the TPU compilation model:
 * The whole num_leaves-1 split loop is a `lax.fori_loop` inside one jit — no
   per-split host round trip (the CUDA learner pays a D2H sync per split;
   SURVEY.md §3.3 flags this as the thing to avoid on TPU).
-* Row partition is a leaf-id recoloring array `leaf_id[n]` with fixed shape,
-  not per-leaf index lists (ref: data_partition.hpp keeps ragged index lists —
-  ragged shapes don't jit).
+* Row partition is a row-permutation `order` with contiguous per-leaf
+  segments — the TPU analogue of DataPartition's per-leaf index lists
+  (ref: data_partition.hpp:21).  Each split reads only the split leaf's
+  segment through a pow2-bucketed `lax.switch` (static shapes), partitions
+  it in place, and builds the smaller child's histogram from just those
+  rows, so a tree costs ~n*log2(L) row visits like the reference's
+  partitioned scan (ref: dense_bin.hpp:99-176), not n*(L-1).
 * Histogram bookkeeping keeps the reference's smaller-child trick: the smaller
   child's histogram is built fresh, the larger's is parent − smaller
   (ref: serial_tree_learner.cpp:334 BeforeFindBestSplit, feature_histogram.hpp
@@ -23,7 +27,9 @@ Design differences from the reference, chosen for the TPU compilation model:
 All reductions over the row axis (histograms, sums, counts) are the only ops
 touching sharded data, so the same program runs data-parallel under pjit with
 rows sharded over a mesh — XLA inserts the psum that replaces
-Network::ReduceScatter (ref: data_parallel_tree_learner.cpp:284).
+Network::ReduceScatter (ref: data_parallel_tree_learner.cpp:284).  (The
+partitioned engine gathers rows by global index, so the data-parallel path
+uses the masked engine: set compact_min=0 under sharding.)
 """
 
 from __future__ import annotations
@@ -34,7 +40,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram
+from ..ops.histogram import build_histogram, build_histogram_rows_pallas
 from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult, find_best_split,
                          MISSING_NAN, MISSING_ZERO)
 
@@ -56,6 +62,12 @@ class GrowParams(NamedTuple):
     split: SplitParams = SplitParams()
     use_hist_stack: bool = True
     hist_method: str = "segment"
+    # Partitioned-segment engine: the split leaf's rows are kept contiguous
+    # in a row permutation and each split touches only that segment through
+    # a pow2 bucket ladder starting at this size.  0 selects the masked
+    # full-scan engine (every split rescans all n rows; needed under row
+    # sharding, where rows may not be gathered by global index).
+    compact_min: int = 4096
 
 
 class TreeArrays(NamedTuple):
@@ -101,6 +113,9 @@ class _State(NamedTuple):
     hist_stack: jnp.ndarray     # [L, F, B, 2] (or [1,1,1,2] dummy)
     leaf_sum_g: jnp.ndarray     # [L]
     leaf_sum_h: jnp.ndarray     # [L]
+    order: jnp.ndarray          # [n + S_max] row permutation (or [1] dummy)
+    leaf_start: jnp.ndarray     # [L] segment starts (partitioned engine)
+    leaf_seg_cnt: jnp.ndarray   # [L] segment lengths incl. bagged-out rows
     done: jnp.ndarray           # scalar bool
 
 
@@ -142,19 +157,58 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sp = params.split
     f32 = jnp.float32
 
-    grad = grad.astype(f32) * row_mask.astype(f32)
-    hess = hess.astype(f32) * row_mask.astype(f32)
+    row_mask = row_mask.astype(f32)
+    grad = grad.astype(f32) * row_mask
+    hess = hess.astype(f32) * row_mask
     gh = jnp.stack([grad, hess], axis=1)
     ones_mask = jnp.ones((n,), dtype=f32)  # grad/hess already carry row_mask
 
+    use_pallas = params.hist_method == "pallas"
+
     def hist_of(member_mask):
+        if use_pallas:
+            return build_histogram_rows_pallas(binned.T, gh, member_mask,
+                                               max_bin=B)
         return build_histogram(binned, gh, member_mask, max_bin=B,
+                               method=params.hist_method)
+
+    def hist_of_rows(rows, gh_sub, member_mask):
+        """Histogram over row-major gathered rows [S, F]."""
+        if use_pallas:
+            return build_histogram_rows_pallas(rows, gh_sub, member_mask,
+                                               max_bin=B)
+        return build_histogram(rows.T, gh_sub, member_mask, max_bin=B,
                                method=params.hist_method)
 
     def best_of(hist, sum_g, sum_h, cnt, parent_out):
         return find_best_split(hist, meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
                                sum_g, sum_h, cnt, parent_out, sp)
+
+    # pow2 bucket ladder for the partitioned engine; the last bucket covers
+    # the whole row range (used by the root split)
+    bucket_sizes = []
+    if 0 < params.compact_min < n and L > 2:
+        s = params.compact_min
+        while s < n:
+            bucket_sizes.append(s)
+            s *= 2
+        bucket_sizes.append(n)
+        # invariant for in-bounds dynamic slices: any segment larger than the
+        # biggest sub-n bucket starts within the first S_MAX rows, so
+        # start + n <= n + S_MAX (the padded order length) always holds
+    use_partition = bool(bucket_sizes)
+    S_MAX = bucket_sizes[-2] if len(bucket_sizes) > 1 else 0
+    # binned in row-major [n, F] for per-segment row gathers (loop-invariant,
+    # hoisted out of the split loop by XLA)
+    binned_rows = binned.T if use_partition else None
+
+    def go_left_of(fbins, feat, dleft, thr):
+        """Partition rule in bin space (ref: dense_bin.hpp:346-366 SplitInner)."""
+        mt_f = meta.missing_type[feat]
+        is_missing = (((mt_f == MISSING_NAN) & (fbins == meta.num_bin[feat] - 1))
+                      | ((mt_f == MISSING_ZERO) & (fbins == meta.default_bin[feat])))
+        return jnp.where(is_missing, dleft, fbins <= thr)
 
     # ---- root (ref: serial_tree_learner BeforeTrain + root leaf splits) ----
     sum_g0 = jnp.sum(grad)
@@ -195,11 +249,100 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     else:
         hist_stack = jnp.zeros((1, 1, 1, 2), f32)
 
+    if use_partition:
+        order0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                                  jnp.zeros(max(S_MAX, 1), jnp.int32)])
+        leaf_start0 = jnp.zeros(L, jnp.int32)
+        leaf_seg_cnt0 = jnp.zeros(L, jnp.int32).at[0].set(n)
+    else:
+        order0 = jnp.zeros(1, jnp.int32)
+        leaf_start0 = jnp.zeros(1, jnp.int32)
+        leaf_seg_cnt0 = jnp.zeros(1, jnp.int32)
+
     state = _State(tree=tree, pending=pending,
                    leaf_id=jnp.zeros(n, jnp.int32), hist_stack=hist_stack,
                    leaf_sum_g=jnp.zeros(L, f32).at[0].set(sum_g0),
                    leaf_sum_h=jnp.zeros(L, f32).at[0].set(sum_h0),
+                   order=order0, leaf_start=leaf_start0,
+                   leaf_seg_cnt=leaf_seg_cnt0,
                    done=jnp.asarray(False))
+
+    def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft):
+        """Partitioned engine: read the split leaf's segment through a pow2
+        bucket, partition it in place (stable: left rows first), recolor the
+        right rows' leaf_id, and build the smaller child's histogram from
+        only the segment's rows (ref: DataPartition::Split +
+        dense_bin.hpp:99 partitioned histogram scan)."""
+        start = st.leaf_start[best_leaf]
+        seg_cnt = st.leaf_seg_cnt[best_leaf]
+
+        def make_branch(S):
+            def branch(operand):
+                order, leaf_id = operand
+                idxs = jax.lax.dynamic_slice(order, (start,), (S,))
+                valid = jnp.arange(S, dtype=jnp.int32) < seg_cnt
+                rows = jnp.take(binned_rows, idxs, axis=0)     # [S, F]
+                fbins = jnp.take(rows, feat, axis=1).astype(jnp.int32)
+                gl = go_left_of(fbins, feat, dleft, thr)
+                lm = gl & valid
+                rm = (~gl) & valid
+                rmask = jnp.take(row_mask, idxs)
+                cnt_l = jnp.sum(lm * rmask).astype(jnp.int32)
+                cnt_r = jnp.sum(rm * rmask).astype(jnp.int32)
+                gh_sub = jnp.take(gh, idxs, axis=0)
+                smaller_is_left = cnt_l <= cnt_r
+                if params.use_hist_stack:
+                    small_m = jnp.where(smaller_is_left, lm, rm)
+                    small_hist = hist_of_rows(rows, gh_sub,
+                                              small_m.astype(f32))
+                else:  # children rebuilt from scratch downstream
+                    small_hist = jnp.zeros((num_features, B, 2), f32)
+                # stable in-place partition of the segment window; slots
+                # beyond seg_cnt keep their original values
+                cl_seg = jnp.sum(lm.astype(jnp.int32))
+                pos = jnp.where(
+                    lm, jnp.cumsum(lm.astype(jnp.int32)) - 1,
+                    jnp.where(rm,
+                              cl_seg + jnp.cumsum(rm.astype(jnp.int32)) - 1,
+                              S))
+                buf = idxs.at[pos].set(idxs, mode="drop")
+                order = jax.lax.dynamic_update_slice(order, buf, (start,))
+                leaf_id = leaf_id.at[jnp.where(rm, idxs, n)].set(
+                    new_leaf, mode="drop")
+                return (order, leaf_id, small_hist, cnt_l, cnt_r, cl_seg,
+                        smaller_is_left)
+            return branch
+
+        branches = [make_branch(S) for S in bucket_sizes]
+        k = jnp.searchsorted(jnp.asarray(bucket_sizes, jnp.int32), seg_cnt)
+        k = jnp.minimum(k, len(bucket_sizes) - 1)
+        (order, leaf_id, small_hist, cnt_l, cnt_r, cl_seg,
+         smaller_is_left) = jax.lax.switch(k, branches,
+                                           (st.order, st.leaf_id))
+        leaf_start = st.leaf_start.at[new_leaf].set(start + cl_seg)
+        leaf_seg_cnt = (st.leaf_seg_cnt.at[best_leaf].set(cl_seg)
+                        .at[new_leaf].set(seg_cnt - cl_seg))
+        return (order, leaf_id, leaf_start, leaf_seg_cnt, small_hist,
+                cnt_l, cnt_r, smaller_is_left)
+
+    def mask_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft):
+        """Masked engine: recolor by scanning all rows (data-parallel safe)."""
+        fbins = jnp.take(binned, feat, axis=0).astype(jnp.int32)
+        gl = go_left_of(fbins, feat, dleft, thr)
+        in_leaf = st.leaf_id == best_leaf
+        leaf_id = jnp.where(in_leaf & ~gl, new_leaf, st.leaf_id)
+        lmaskf = (in_leaf & gl).astype(f32) * row_mask
+        rmaskf = (in_leaf & ~gl).astype(f32) * row_mask
+        cnt_l = jnp.sum(lmaskf).astype(jnp.int32)
+        cnt_r = jnp.sum(rmaskf).astype(jnp.int32)
+        smaller_is_left = cnt_l <= cnt_r
+        if params.use_hist_stack:
+            small_mask = jnp.where(smaller_is_left, lmaskf, rmaskf)
+            small_hist = hist_of(small_mask)
+        else:  # children rebuilt from scratch downstream
+            small_hist = jnp.zeros((num_features, B, 2), f32)
+        return (st.order, leaf_id, st.leaf_start, st.leaf_seg_cnt, small_hist,
+                cnt_l, cnt_r, smaller_is_left)
 
     def body(i, st: _State):
         # leaf selection (ref: serial_tree_learner.cpp:219 ArgMax over leaves);
@@ -219,20 +362,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             thr = pd.threshold[best_leaf]
             dleft = pd.default_left[best_leaf]
 
-            # --- partition by recoloring (ref: dense_bin.hpp:346-366 SplitInner) ---
-            fbins = jnp.take(binned, feat, axis=0).astype(jnp.int32)
-            mt_f = meta.missing_type[feat]
-            is_missing = (((mt_f == MISSING_NAN) & (fbins == meta.num_bin[feat] - 1))
-                          | ((mt_f == MISSING_ZERO) & (fbins == meta.default_bin[feat])))
-            go_left = jnp.where(is_missing, dleft, fbins <= thr)
-            in_leaf = st.leaf_id == best_leaf
-            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
-
-            # actual per-child counts (ref: DataPartition gives actual counts)
-            lmaskf = (in_leaf & go_left).astype(f32) * row_mask.astype(f32)
-            rmaskf = (in_leaf & ~go_left).astype(f32) * row_mask.astype(f32)
-            cnt_l = jnp.sum(lmaskf).astype(jnp.int32)
-            cnt_r = jnp.sum(rmaskf).astype(jnp.int32)
+            engine = partition_and_hist if use_partition else mask_and_hist
+            (order, leaf_id, leaf_start, leaf_seg_cnt, small_hist,
+             cnt_l, cnt_r, smaller_is_left) = engine(
+                st, best_leaf, new_leaf, feat, thr, dleft)
 
             # --- tree arrays (ref: tree.cpp Tree::Split) ---
             t = st.tree
@@ -274,10 +407,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # (ref: serial_tree_learner.cpp histogram subtraction) ---
             lsum_g, lsum_h = pd.left_sum_gradient[best_leaf], pd.left_sum_hessian[best_leaf]
             rsum_g, rsum_h = pd.right_sum_gradient[best_leaf], pd.right_sum_hessian[best_leaf]
-            smaller_is_left = cnt_l <= cnt_r
             if params.use_hist_stack:
-                small_mask = jnp.where(smaller_is_left, lmaskf, rmaskf)
-                small_hist = hist_of(small_mask)
                 parent_hist = st.hist_stack[best_leaf]
                 large_hist = parent_hist - small_hist
                 hist_l = jnp.where(smaller_is_left, small_hist, large_hist)
@@ -285,6 +415,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 hist_stack = (st.hist_stack.at[best_leaf].set(hist_l)
                               .at[new_leaf].set(hist_r))
             else:
+                # rebuild both children (memory-constrained mode)
+                lmaskf = (leaf_id == best_leaf).astype(f32) * row_mask
+                rmaskf = (leaf_id == new_leaf).astype(f32) * row_mask
                 hist_l = hist_of(lmaskf)
                 hist_r = hist_of(rmaskf)
                 hist_stack = st.hist_stack
@@ -301,6 +434,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                                   .at[new_leaf].set(rsum_g),
                           leaf_sum_h=st.leaf_sum_h.at[best_leaf].set(lsum_h)
                                                   .at[new_leaf].set(rsum_h),
+                          order=order, leaf_start=leaf_start,
+                          leaf_seg_cnt=leaf_seg_cnt,
                           done=st.done)
 
         return jax.lax.cond(proceed, do_split,

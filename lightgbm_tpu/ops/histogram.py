@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 
 def _pick_chunk(n: int, num_features: int, max_bin: int, method: str) -> int:
@@ -40,6 +41,8 @@ def _pick_chunk(n: int, num_features: int, max_bin: int, method: str) -> int:
     the memory driver (keep it ~64MB); for `segment` the flat id/value copies
     are (keep F*R around 4M)."""
     if method == "onehot":
+        r = (64 << 20) // max(num_features * max_bin * 2, 1)
+    elif method == "onehot_hp":
         r = (64 << 20) // max(num_features * max_bin * 4, 1)
     else:
         r = (1 << 22) // max(num_features, 1)
@@ -65,16 +68,72 @@ def _hist_chunk_segment(binned_c: jnp.ndarray, gh_c: jnp.ndarray,
 
 
 def _hist_chunk_onehot(binned_c: jnp.ndarray, gh_c: jnp.ndarray,
-                       num_bins_total: int, max_bin: int) -> jnp.ndarray:
-    """One chunk via MXU one-hot matmul: [C, R] @ [R, F*B] with C=gh channels."""
+                       num_bins_total: int, max_bin: int,
+                       compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """One chunk via MXU one-hot matmul: [C, R] @ [R, F*B] with C=gh channels.
+
+    Default is single-pass bf16 multiply with fp32 accumulation — the
+    one-hot side is exact in bf16 and the reference's own GPU learner uses
+    single-precision histograms by default (ref: gpu_tree_learner.h:79
+    gpu_use_dp=false; its quantized path even uses int8 grads).  Pass
+    compute_dtype=float32 for the 3-pass high-precision variant.
+    """
     num_features, rows = binned_c.shape
     onehot = (binned_c[:, :, None] ==
               jnp.arange(max_bin, dtype=binned_c.dtype)[None, None, :])
-    onehot = onehot.astype(gh_c.dtype)                      # [F, R, B]
+    onehot = onehot.astype(compute_dtype)                   # [F, R, B]
     onehot = jnp.transpose(onehot, (1, 0, 2)).reshape(rows, num_features * max_bin)
+    precision = (jax.lax.Precision.HIGH if compute_dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
     return jax.lax.dot_general(
-        gh_c, onehot, (((0,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGH).T                 # [F*B, C]
+        gh_c.astype(compute_dtype), onehot, (((0,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32).T               # [F*B, C]
+
+
+def _hist_pallas_kernel(F: int, Bp: int, C: int):
+    """Fused one-hot histogram kernel: the [Rt, Bp] one-hot tiles exist only
+    in VMEM (never HBM), so traffic is just the binned rows + gh — the
+    Pallas analogue of the CUDA shared-memory histogram kernel
+    (ref: cuda_histogram_constructor.cu:18-230, which accumulates per-block
+    histograms in shared memory for the same reason)."""
+    def kernel(rows_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        rows = rows_ref[...].astype(jnp.int32)        # [Rt, F]
+        ghv = gh_ref[...].astype(jnp.bfloat16)        # [Rt, C]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (rows.shape[0], Bp), 1)
+        for f in range(F):
+            onehot = (rows[:, f:f + 1] == iota).astype(jnp.bfloat16)
+            acc = jax.lax.dot_general(
+                ghv, onehot, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)   # [C, Bp]
+            out_ref[:, f, :] += acc
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile"))
+def build_histogram_rows_pallas(rows: jnp.ndarray, gh: jnp.ndarray,
+                                mask: jnp.ndarray, *, max_bin: int,
+                                row_tile: int = 512) -> jnp.ndarray:
+    """Histogram over row-major binned data [S, F] via the fused Pallas
+    kernel.  S must be a multiple of row_tile.  Returns [F, B, C] float32."""
+    S, F = rows.shape
+    C = gh.shape[-1]
+    Bp = (max_bin + 127) // 128 * 128
+    if S % row_tile != 0:
+        raise ValueError(f"rows {S} not a multiple of row_tile {row_tile}")
+    gh = (gh * mask.astype(gh.dtype)[:, None]).astype(jnp.float32)
+    out = pl.pallas_call(
+        _hist_pallas_kernel(F, Bp, C),
+        grid=(S // row_tile,),
+        in_specs=[pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
+                  pl.BlockSpec((row_tile, C), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((C, F, Bp), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, F, Bp), jnp.float32),
+    )(rows, gh)
+    return out.transpose(1, 2, 0)[:, :max_bin, :]     # [F, B, C]
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "method", "row_chunk"))
@@ -98,7 +157,15 @@ def build_histogram(binned: jnp.ndarray, gh: jnp.ndarray, mask: jnp.ndarray,
     gh = gh * mask.astype(gh.dtype)[:, None]
     total = num_features * max_bin
     chunk = row_chunk or _pick_chunk(n, num_features, max_bin, method)
-    kernel = _hist_chunk_segment if method == "segment" else _hist_chunk_onehot
+    if method == "segment":
+        kernel = _hist_chunk_segment
+    elif method == "onehot":
+        kernel = _hist_chunk_onehot
+    elif method == "onehot_hp":
+        kernel = functools.partial(_hist_chunk_onehot,
+                                   compute_dtype=jnp.float32)
+    else:
+        raise ValueError(f"unknown histogram method {method!r}")
     if n <= chunk:
         out = kernel(binned, gh, total, max_bin)
         return out.reshape(num_features, max_bin, channels)

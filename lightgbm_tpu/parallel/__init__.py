@@ -8,7 +8,8 @@ recursive-halving reduce-scatter over TCP, rows are sharded over a mesh axis
 and XLA inserts the psum/all_gather collectives over ICI/DCN.
 """
 
-from .data_parallel import (data_parallel_shardings, make_mesh,
+from .data_parallel import (data_parallel_shardings, grow_params_for_mesh, make_mesh,
                             shard_for_data_parallel)
 
-__all__ = ["data_parallel_shardings", "make_mesh", "shard_for_data_parallel"]
+__all__ = ["data_parallel_shardings", "grow_params_for_mesh", "make_mesh",
+           "shard_for_data_parallel"]

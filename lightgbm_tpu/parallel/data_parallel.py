@@ -52,6 +52,14 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devices), (DATA_AXIS,))
 
 
+def grow_params_for_mesh(params):
+    """Adjust GrowParams for sharded rows: the partitioned-segment engine
+    gathers rows by global index, which under GSPMD would all-gather the
+    binned matrix per split — so sharded training uses the masked engine
+    (compact_min=0), whose only row-axis ops are reductions and maps."""
+    return params._replace(compact_min=0)
+
+
 def data_parallel_shardings(mesh: Mesh) -> Tuple:
     """(binned, per-row vectors, replicated) shardings for grow_tree args."""
     row = NamedSharding(mesh, P(DATA_AXIS))

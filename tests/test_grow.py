@@ -133,3 +133,55 @@ def test_subtraction_equals_rebuild():
     np.testing.assert_allclose(np.asarray(t1.leaf_value), np.asarray(t2.leaf_value),
                                rtol=1e-3, atol=1e-4)
     np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_compaction_matches_full_scan():
+    """Smaller-child row compaction must produce the identical tree to the
+    full masked scan (it gathers exactly the child's rows; fp32 segment
+    histograms make both paths bit-comparable)."""
+    n, F, B = 4096, 6, 32
+    rng = np.random.RandomState(11)
+    binned = rng.randint(0, B, size=(F, n)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    base = GrowParams(num_leaves=31, max_bin=B,
+                      split=SplitParams(min_data_in_leaf=5),
+                      hist_method="segment")
+    t_full, lid_full = _grow(binned, grad, hess,
+                             base._replace(compact_min=0))
+    t_comp, lid_comp = _grow(binned, grad, hess,
+                             base._replace(compact_min=128))
+    assert int(t_full.num_leaves) == int(t_comp.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t_full.split_feature),
+                                  np.asarray(t_comp.split_feature))
+    np.testing.assert_array_equal(np.asarray(t_full.threshold_bin),
+                                  np.asarray(t_comp.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(lid_full), np.asarray(lid_comp))
+    np.testing.assert_allclose(np.asarray(t_full.leaf_value),
+                               np.asarray(t_comp.leaf_value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compaction_with_bagging_mask():
+    """Bagged-out rows are excluded from compaction buffers (their gh is
+    zero AND they are not gathered), so masked training matches."""
+    n, F, B = 2048, 4, 16
+    rng = np.random.RandomState(12)
+    binned = rng.randint(0, B, size=(F, n)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    row_mask = (rng.rand(n) > 0.4).astype(np.float32)
+    meta = _meta(F, B)
+    base = GrowParams(num_leaves=15, max_bin=B,
+                      split=SplitParams(min_data_in_leaf=3),
+                      hist_method="segment")
+    import jax.numpy as jnp_
+    args = (jnp_.array(binned), jnp_.array(grad), jnp_.array(hess),
+            jnp_.array(row_mask), jnp_.ones(F, bool), meta)
+    t_full, _ = grow_tree(*args, base._replace(compact_min=0))
+    t_comp, _ = grow_tree(*args, base._replace(compact_min=128))
+    assert int(t_full.num_leaves) == int(t_comp.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t_full.split_feature),
+                                  np.asarray(t_comp.split_feature))
+    np.testing.assert_array_equal(np.asarray(t_full.threshold_bin),
+                                  np.asarray(t_comp.threshold_bin))
