@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.binning import BIN_CATEGORICAL
 from ..io.dataset import Dataset
-from ..learner import FeatureMeta, GrowParams, grow_tree
+from ..learner import FeatureMeta, GrowParams, grow_tree, grow_tree_wave
 from ..models.tree import Tree
 from ..objective import ObjectiveFunction
 from ..ops.split import SplitParams
@@ -177,6 +177,13 @@ class GBDT:
             # (ref: gpu_tree_learner.h:79 single-precision default).
             hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
                          if jax.default_backend() == "tpu" else "segment"))
+        # growth engine: wave (level-batched, TPU-fast) vs strict leaf-wise
+        strategy = config.tpu_growth_strategy
+        if strategy == "auto":
+            strategy = ("wave" if jax.default_backend() == "tpu"
+                        and config.num_leaves >= 8 else "leafwise")
+        self._grow_fn = grow_tree_wave if strategy == "wave" else grow_tree
+        self.growth_strategy = strategy
 
         # scores [K, n_pad] on device
         K = self.num_tree_per_iteration
@@ -401,7 +408,7 @@ class GBDT:
         for k in range(K):
             tree = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
-                arrays, leaf_id = grow_tree(
+                arrays, leaf_id = self._grow_fn(
                     self.binned_dev, grad[k], hess[k], bag_mask,
                     self._col_mask(), self.meta, self.grow_params)
                 tree = self._finalize_tree(arrays, leaf_id, k, init_scores[k])

@@ -71,7 +71,6 @@ class RF(GBDT):
         """ref: rf.hpp:117 TrainOneIter — never stops, never shrinks."""
         if gradients is not None or hessians is not None:
             log.fatal("RF mode does not support custom objective functions")
-        from ..learner import grow_tree
 
         K = self.num_tree_per_iteration
         bag_mask, grad, hess = self._update_bagging(self._rf_grad,
@@ -81,7 +80,7 @@ class RF(GBDT):
             tree = None
             leaf_id = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
-                arrays, leaf_id = grow_tree(
+                arrays, leaf_id = self._grow_fn(
                     self.binned_dev, grad[k], hess[k], bag_mask,
                     self._col_mask(), self.meta, self.grow_params)
                 tree = self._arrays_to_tree(arrays)

@@ -96,6 +96,10 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     ("learning_rate", "float", 0.1, ("shrinkage_rate", "eta")),
     ("num_leaves", "int", 31, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")),
     ("tree_learner", "str", "serial", ("tree", "tree_type", "tree_learner_type")),
+    # TPU-specific: tree growth engine.  "wave" splits every positive-gain
+    # leaf per round (vectorized, TPU-fast); "leafwise" is the strict
+    # one-split-at-a-time reference-parity engine; "auto" picks wave on TPU.
+    ("tpu_growth_strategy", "str", "auto", ("growth_strategy",)),
     ("num_threads", "int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
     ("device_type", "str", "tpu", ("device",)),
     ("seed", "int", 0, ("random_seed", "random_state")),

@@ -6,5 +6,7 @@ of a host-driven kernel-launch loop, per SURVEY.md §3.3's TPU lesson.
 """
 
 from .grow import FeatureMeta, GrowParams, TreeArrays, grow_tree, make_grow_tree
+from .wave import grow_tree_wave
 
-__all__ = ["FeatureMeta", "GrowParams", "TreeArrays", "grow_tree", "make_grow_tree"]
+__all__ = ["FeatureMeta", "GrowParams", "TreeArrays", "grow_tree",
+           "grow_tree_wave", "make_grow_tree"]

@@ -136,6 +136,76 @@ def build_histogram_rows_pallas(rows: jnp.ndarray, gh: jnp.ndarray,
     return out.transpose(1, 2, 0)[:, :max_bin, :]     # [F, B, C]
 
 
+def _wave_kernel(G: int, Fg: int, Bp: int, NL: int):
+    """Multi-leaf fused histogram kernel for wave (level-batched) growth:
+    per row tile, build per-feature-group one-hots [Fg*Bp, Rt] and a
+    per-leaf-slot gh matrix [Rt, NL] in VMEM, then one MXU dot per group
+    and channel yields all leaves' histograms at once — the TPU replacement
+    for the CUDA per-leaf shared-memory kernels
+    (ref: cuda_histogram_constructor.cu:18)."""
+    def kernel(rows_ref, slot_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        rows = rows_ref[...].astype(jnp.int32)           # [Fg, Rt]
+        slot = slot_ref[...].astype(jnp.int32)           # [Rt, 1]
+        gh = gh_ref[...]                                 # [Rt, 2]
+        Rt = rows.shape[1]
+        soh = (slot == jax.lax.broadcasted_iota(jnp.int32, (Rt, NL), 1))
+        sg = soh.astype(jnp.bfloat16) * gh[:, 0:1].astype(jnp.bfloat16)
+        sh = soh.astype(jnp.bfloat16) * gh[:, 1:2].astype(jnp.bfloat16)
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bp, Rt), 1)
+        oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
+        oh2 = oh.reshape(Fg * Bp, Rt)
+        accg = jax.lax.dot_general(oh2, sg, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        acch = jax.lax.dot_general(oh2, sh, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        out_ref[0] += accg.reshape(Fg, Bp, NL)
+        out_ref[1] += acch.reshape(Fg, Bp, NL)
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "num_slots", "row_tile"))
+def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
+                         gh: jnp.ndarray, *, max_bin: int, num_slots: int,
+                         row_tile: int = 512) -> jnp.ndarray:
+    """Histograms for all leaf slots in one pass.
+
+    Args:
+      binned_fm: [F, n] feature-major bin codes.
+      slot: [n] int32 leaf slot per row (use num_slots-1+garbage for rows
+        that must not contribute, with gh zeroed by the mask).
+      gh: [n, 2] per-row gradient/hessian (already masked).
+      max_bin: B (static).  num_slots: NL leaf slots (static).
+
+    Returns: [NL, F, B, 2] float32.
+    """
+    F, n = binned_fm.shape
+    Bp = (max_bin + 127) // 128 * 128
+    NLp = max(8, (num_slots + 7) // 8 * 8)
+    if n % row_tile != 0:
+        raise ValueError(f"n {n} not a multiple of row_tile {row_tile}")
+    # feature group size bounded by the VMEM accumulator [2, Fg, Bp, NLp]
+    budget = 4 * (2 << 20)
+    Fg = max(1, min(F, budget // max(2 * Bp * NLp * 4, 1)))
+    while F % Fg != 0:
+        Fg -= 1
+    G = F // Fg
+    out = pl.pallas_call(
+        _wave_kernel(G, Fg, Bp, NLp),
+        grid=(G, n // row_tile),
+        in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
+                  pl.BlockSpec((row_tile, 1), lambda g, i: (i, 0)),
+                  pl.BlockSpec((row_tile, 2), lambda g, i: (i, 0))],
+        out_specs=pl.BlockSpec((2, Fg, Bp, NLp), lambda g, i: (0, g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, F, Bp, NLp), jnp.float32),
+    )(binned_fm, slot.reshape(n, 1), gh)
+    # [2, F, Bp, NLp] -> [NL, F, B, 2]
+    return out.transpose(3, 1, 2, 0)[:num_slots, :, :max_bin, :]
+
+
 @functools.partial(jax.jit, static_argnames=("max_bin", "method", "row_chunk"))
 def build_histogram(binned: jnp.ndarray, gh: jnp.ndarray, mask: jnp.ndarray,
                     *, max_bin: int, method: str = "segment",
