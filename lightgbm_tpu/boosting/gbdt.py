@@ -177,11 +177,29 @@ class GBDT:
             # (ref: gpu_tree_learner.h:79 single-precision default).
             hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
                          if jax.default_backend() == "tpu" else "segment"))
-        # growth engine: wave (level-batched, TPU-fast) vs strict leaf-wise
+        # growth engine: wave (level-batched, TPU-fast for small leaf
+        # counts — its dense slot one-hot pays num_leaves MACs per row-bin)
+        # vs strict leaf-wise (partitioned segments, n*log(L) row visits)
+        from ..ops.histogram import wave_pallas_vmem_ok
         strategy = config.tpu_growth_strategy
+        if strategy not in ("auto", "wave", "leafwise"):
+            log.fatal(f"Unknown tpu_growth_strategy {strategy!r}; "
+                      "expected auto, wave, or leafwise")
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"
-                        and config.num_leaves >= 8 else "leafwise")
+                        and 8 <= config.num_leaves <= 64
+                        and self.grow_params.hist_method == "pallas"
+                        and wave_pallas_vmem_ok(len(nb), max_b,
+                                                config.num_leaves)
+                        else "leafwise")
+        elif (strategy == "wave" and jax.default_backend() == "tpu"
+              and not (self.grow_params.hist_method == "pallas"
+                       and wave_pallas_vmem_ok(len(nb), max_b,
+                                               config.num_leaves))):
+            log.warning("tpu_growth_strategy=wave without the fused Pallas "
+                        "histogram falls back to the XLA one-hot wave "
+                        "histogram, which materializes [F, n, B] — only "
+                        "viable for small datasets")
         self._grow_fn = grow_tree_wave if strategy == "wave" else grow_tree
         self.growth_strategy = strategy
 

@@ -18,9 +18,12 @@ work:
      single packed [L, 8] table row-gather; ref: dense_bin.hpp:346
      SplitInner applied to all splitting leaves at once).
 
-Tree shape: identical to leaf-wise when every leaf keeps splitting (the
-usual case); when the num_leaves budget binds mid-round only the highest-
-gain leaves split, matching leaf-wise's preference.  All row-axis ops are
+Tree shape: identical to leaf-wise when split gains decrease monotonically
+with depth (the common case on real losses); on non-monotone gain
+landscapes leaf-wise may deepen one branch where wave spreads a level, a
+quality-neutral tradeoff (XGBoost's depthwise analogue).  When the
+num_leaves budget binds mid-round only the highest-gain leaves split,
+matching leaf-wise's preference.  All row-axis ops are
 reductions/maps, so the engine shards over a data mesh without changes.
 """
 
@@ -42,9 +45,11 @@ def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
     oh_slot = (slot[:, None] == jnp.arange(num_slots)[None, :])  # [n, NL]
     oh_bin = (binned_fm[:, :, None] ==
               jnp.arange(max_bin, dtype=jnp.int32)[None, None, :])  # [F,n,B]
-    # [NL, F, B, C]
+    # [NL, F, B, C]; histograms are exact accumulators, so force fp32
+    # contraction (the TPU default would round operands to bf16)
     return jnp.einsum("nl,fnb,nc->lfbc", oh_slot.astype(jnp.float32),
-                      oh_bin.astype(jnp.float32), gh)
+                      oh_bin.astype(jnp.float32), gh,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -66,7 +71,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     hess = hess.astype(f32) * row_mask
     gh = jnp.stack([grad, hess], axis=1)
 
-    use_pallas = params.hist_method == "pallas"
+    from ..ops.histogram import wave_pallas_vmem_ok
+    use_pallas = (params.hist_method == "pallas"
+                  and wave_pallas_vmem_ok(num_features, B, L))
 
     def hists_of(leaf_id):
         if use_pallas:
@@ -161,8 +168,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         internal_value = nset(t.internal_value, t.leaf_value)
         internal_weight = nset(t.internal_weight,
                                best.left_sum_hessian + best.right_sum_hessian)
-        internal_count = nset(t.internal_count,
-                              best.left_count + best.right_count)
+        internal_count = nset(t.internal_count, t.leaf_count)  # exact
 
         # leaf records: old slot becomes the left child, new slot the right
         ldrop = jnp.where(split_sel, jnp.arange(L, dtype=i32), L)
@@ -174,7 +180,6 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_value = lset(t.leaf_value, best.left_output, best.right_output)
         leaf_weight = lset(t.leaf_weight, best.left_sum_hessian,
                            best.right_sum_hessian)
-        leaf_count = lset(t.leaf_count, best.left_count, best.right_count)
         leaf_parent = lset(t.leaf_parent, sl_nodes, sl_nodes)
         leaf_depth = lset(t.leaf_depth, depth1, depth1)
         leaf_sum_g = lset(leaf_sum_g, best.left_sum_gradient,
@@ -182,17 +187,6 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_sum_h = lset(leaf_sum_h, best.left_sum_hessian,
                           best.right_sum_hessian)
         leaf_out = lset(leaf_out, best.left_output, best.right_output)
-
-        tree = TreeArrays(
-            num_leaves=NL + n_split,
-            split_feature=split_feature, threshold_bin=threshold_bin,
-            default_left=default_left, split_gain=split_gain,
-            left_child=left_child, right_child=right_child,
-            internal_value=internal_value, internal_weight=internal_weight,
-            internal_count=internal_count,
-            leaf_value=leaf_value, leaf_weight=leaf_weight,
-            leaf_count=leaf_count, leaf_parent=leaf_parent,
-            leaf_depth=leaf_depth)
 
         # 4. recolor rows: one packed [L, 8] table row-gather per row
         packed = jnp.stack(
@@ -218,6 +212,25 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                       | ((mt_r == MISSING_ZERO) & (fbin == db_r)))
         go_left = jnp.where(is_missing, dleft_r, fbin <= thr_r)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
+
+        # exact leaf counts from the new partition (the scan's counts are
+        # the reference's hess*cnt_factor RoundInt approximation; the Tree
+        # stores DataPartition's exact counts, ref: tree.cpp Tree::Split
+        # leaf_count_ from cnt_leaf_data) — also fed back to the next
+        # round's gain scan as num_data
+        leaf_count = (jnp.zeros(L, f32).at[leaf_id].add(row_mask)
+                      .astype(i32))
+
+        tree = TreeArrays(
+            num_leaves=NL + n_split,
+            split_feature=split_feature, threshold_bin=threshold_bin,
+            default_left=default_left, split_gain=split_gain,
+            left_child=left_child, right_child=right_child,
+            internal_value=internal_value, internal_weight=internal_weight,
+            internal_count=internal_count,
+            leaf_value=leaf_value, leaf_weight=leaf_weight,
+            leaf_count=leaf_count, leaf_parent=leaf_parent,
+            leaf_depth=leaf_depth)
 
         return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, n_split)
 

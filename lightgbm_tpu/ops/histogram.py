@@ -91,25 +91,26 @@ def _hist_chunk_onehot(binned_c: jnp.ndarray, gh_c: jnp.ndarray,
         preferred_element_type=jnp.float32).T               # [F*B, C]
 
 
-def _hist_pallas_kernel(F: int, Bp: int, C: int):
-    """Fused one-hot histogram kernel: the [Rt, Bp] one-hot tiles exist only
-    in VMEM (never HBM), so traffic is just the binned rows + gh — the
-    Pallas analogue of the CUDA shared-memory histogram kernel
-    (ref: cuda_histogram_constructor.cu:18-230, which accumulates per-block
+def _hist_pallas_kernel(Fg: int, Bp: int, C: int):
+    """Fused one-hot histogram kernel: per (feature-group, row-tile) build
+    the [Fg, Bp, Rt] one-hot in VMEM only (never HBM) and contract all
+    features' bins against gh in ONE MXU dot — the Pallas analogue of the
+    CUDA shared-memory histogram kernel (ref:
+    cuda_histogram_constructor.cu:18-230, which accumulates per-block
     histograms in shared memory for the same reason)."""
     def kernel(rows_ref, gh_ref, out_ref):
-        @pl.when(pl.program_id(0) == 0)
+        @pl.when(pl.program_id(1) == 0)
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
-        rows = rows_ref[...].astype(jnp.int32)        # [Rt, F]
+        rows = rows_ref[...].astype(jnp.int32)        # [Fg, Rt]
         ghv = gh_ref[...].astype(jnp.bfloat16)        # [Rt, C]
-        iota = jax.lax.broadcasted_iota(jnp.int32, (rows.shape[0], Bp), 1)
-        for f in range(F):
-            onehot = (rows[:, f:f + 1] == iota).astype(jnp.bfloat16)
-            acc = jax.lax.dot_general(
-                ghv, onehot, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)   # [C, Bp]
-            out_ref[:, f, :] += acc
+        Rt = rows.shape[1]
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bp, Rt), 1)
+        oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)  # [Fg, Bp, Rt]
+        acc = jax.lax.dot_general(
+            oh.reshape(Fg * Bp, Rt), ghv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [Fg*Bp, C]
+        out_ref[...] += acc.reshape(Fg, Bp, C)
     return kernel
 
 
@@ -125,18 +126,25 @@ def build_histogram_rows_pallas(rows: jnp.ndarray, gh: jnp.ndarray,
     if S % row_tile != 0:
         raise ValueError(f"rows {S} not a multiple of row_tile {row_tile}")
     gh = (gh * mask.astype(gh.dtype)[:, None]).astype(jnp.float32)
+    # feature-major layout; pad F to the TPU's 8-sublane block granule
+    Fp = (F + 7) // 8 * 8
+    rows_fm = rows.T
+    if Fp != F:
+        rows_fm = jnp.pad(rows_fm, ((0, Fp - F), (0, 0)))
+    # feature group bounded by the [Fg, Bp, Rt] bf16 one-hot in VMEM (~2MB)
+    Fg = _pick_feature_group(Fp, Bp * row_tile * 2, 2 << 20)
     out = pl.pallas_call(
-        _hist_pallas_kernel(F, Bp, C),
-        grid=(S // row_tile,),
-        in_specs=[pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
-                  pl.BlockSpec((row_tile, C), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((C, F, Bp), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((C, F, Bp), jnp.float32),
-    )(rows, gh)
-    return out.transpose(1, 2, 0)[:, :max_bin, :]     # [F, B, C]
+        _hist_pallas_kernel(Fg, Bp, C),
+        grid=(Fp // Fg, S // row_tile),
+        in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
+                  pl.BlockSpec((row_tile, C), lambda g, i: (i, 0))],
+        out_specs=pl.BlockSpec((Fg, Bp, C), lambda g, i: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp, Bp, C), jnp.float32),
+    )(rows_fm, gh)
+    return out[:F, :max_bin, :]                       # [F, B, C]
 
 
-def _wave_kernel(G: int, Fg: int, Bp: int, NL: int):
+def _wave_kernel(Fg: int, Bp: int, NL: int):
     """Multi-leaf fused histogram kernel for wave (level-batched) growth:
     per row tile, build per-feature-group one-hots [Fg*Bp, Rt] and a
     per-leaf-slot gh matrix [Rt, NL] in VMEM, then one MXU dot per group
@@ -166,12 +174,38 @@ def _wave_kernel(G: int, Fg: int, Bp: int, NL: int):
     return kernel
 
 
+def _pick_feature_group(Fp: int, unit_bytes: int, budget: int) -> int:
+    """Largest 8-multiple divisor of Fp whose VMEM cost Fg*unit_bytes fits
+    the budget (TPU blocks need 8-aligned sublane dims; 8 is the floor)."""
+    Fg = 8
+    for cand in range(8, Fp + 1, 8):
+        if Fp % cand == 0 and cand * unit_bytes <= budget:
+            Fg = cand
+    return Fg
+
+
+def wave_pallas_vmem_ok(num_features: int, max_bin: int,
+                        num_slots: int) -> bool:
+    """True when the wave kernel fits TPU VMEM: the per-group accumulator at
+    the smallest legal (8-aligned) feature group, AND the full output array
+    — XLA may scope a pallas result into VMEM when its consumer is fused."""
+    Bp = (max_bin + 127) // 128 * 128
+    NLp = max(8, (num_slots + 7) // 8 * 8)
+    Fp = (num_features + 7) // 8 * 8
+    return (2 * 8 * Bp * NLp * 4 <= (4 << 20)
+            and 2 * Fp * Bp * NLp * 4 <= (6 << 20))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("max_bin", "num_slots", "row_tile"))
 def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
                          gh: jnp.ndarray, *, max_bin: int, num_slots: int,
                          row_tile: int = 512) -> jnp.ndarray:
     """Histograms for all leaf slots in one pass.
+
+    The dense slot one-hot matmul pays NLp MACs per (row, feature, bin), so
+    this kernel is for the small-leaf-count regime; callers gate on
+    wave_pallas_vmem_ok and leaf count (gbdt.py growth-strategy dispatch).
 
     Args:
       binned_fm: [F, n] feature-major bin codes.
@@ -187,23 +221,24 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     NLp = max(8, (num_slots + 7) // 8 * 8)
     if n % row_tile != 0:
         raise ValueError(f"n {n} not a multiple of row_tile {row_tile}")
+    # TPU block constraint: the binned block's second-to-last dim (Fg) must
+    # be a multiple of 8 (or the full F) — pad features to 8 and group
+    Fp = (F + 7) // 8 * 8
+    if Fp != F:
+        binned_fm = jnp.pad(binned_fm, ((0, Fp - F), (0, 0)))
     # feature group size bounded by the VMEM accumulator [2, Fg, Bp, NLp]
-    budget = 4 * (2 << 20)
-    Fg = max(1, min(F, budget // max(2 * Bp * NLp * 4, 1)))
-    while F % Fg != 0:
-        Fg -= 1
-    G = F // Fg
+    Fg = _pick_feature_group(Fp, 2 * Bp * NLp * 4, 4 << 20)
     out = pl.pallas_call(
-        _wave_kernel(G, Fg, Bp, NLp),
-        grid=(G, n // row_tile),
+        _wave_kernel(Fg, Bp, NLp),
+        grid=(Fp // Fg, n // row_tile),
         in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
                   pl.BlockSpec((row_tile, 1), lambda g, i: (i, 0)),
                   pl.BlockSpec((row_tile, 2), lambda g, i: (i, 0))],
         out_specs=pl.BlockSpec((2, Fg, Bp, NLp), lambda g, i: (0, g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, F, Bp, NLp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((2, Fp, Bp, NLp), jnp.float32),
     )(binned_fm, slot.reshape(n, 1), gh)
-    # [2, F, Bp, NLp] -> [NL, F, B, 2]
-    return out.transpose(3, 1, 2, 0)[:num_slots, :, :max_bin, :]
+    # [2, Fp, Bp, NLp] -> [NL, F, B, 2]
+    return out.transpose(3, 1, 2, 0)[:num_slots, :F, :max_bin, :]
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "method", "row_chunk"))
