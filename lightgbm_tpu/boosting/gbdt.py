@@ -227,11 +227,24 @@ class GBDT:
             if getattr(objective, "need_train", True) is False:
                 self.class_need_train = [False] * K
             if not getattr(objective, "run_on_host", False):
-                # one jitted gradient program per training run (the reference
-                # objective loop is a single OMP pass; ours is a single XLA
-                # program, not per-op eager dispatch)
-                self._grad_fn = jax.jit(lambda sc: objective.get_gradients(
-                    sc, self.label_dev, self.weight_dev))
+                # one jitted gradient program per training run, taking the
+                # FULL [K, n] scores and returning [K, n] grads.  All large
+                # arrays are EXPLICIT arguments: a jit that closes over a
+                # big device array embeds it as a constant, which on the
+                # remote-TPU runtime permanently degrades every subsequent
+                # dispatch in the process (~110ms floor); slicing/expansion
+                # also stay inside jit (eager device ops cost ~100ms each).
+                if self.num_tree_per_iteration > 1:
+                    self._grad_fn_raw = jax.jit(
+                        lambda sc, lab, w: objective.get_gradients(
+                            sc, lab, w))
+                else:  # single-model path: slice + expand inside jit
+                    def _grad1(sc, lab, w):
+                        g, h = objective.get_gradients(sc[0], lab, w)
+                        return g[None, :], h[None, :]
+                    self._grad_fn_raw = jax.jit(_grad1)
+                self._grad_fn = lambda sc: self._grad_fn_raw(
+                    sc, self.label_dev, self.weight_dev)
         for m in self.train_metrics:
             m.init(md, n)
         self.init_scores_applied = [0.0] * K
@@ -242,6 +255,12 @@ class GBDT:
                              jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
             return scores.at[class_id].add(delta * pad_mask)
         self._score_update_fn = _score_update
+        # hot-path helpers kept inside jit (eager device ops are ~100ms
+        # each through the remote-TPU tunnel)
+        self._slice_row_fn = jax.jit(
+            lambda a, k: jax.lax.dynamic_index_in_dim(a, k, 0,
+                                                      keepdims=False))
+        self._score_add_fn = jax.jit(lambda sc, k, v: sc.at[k].add(v))
         self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._ones_col_mask = jnp.ones(len(nb), bool)
@@ -327,7 +346,7 @@ class GBDT:
         if cfg.boost_from_average or self.train_data.num_features == 0:
             init = obj.boost_from_score(class_id)
             if abs(init) > K_EPSILON:
-                self.scores = self.scores.at[class_id].add(init)
+                self.scores = self._score_add_fn(self.scores, class_id, init)
                 for sc in self.valid_scores:
                     sc[class_id] += init
                 log.info(f"Start training from score {init:.6f}")
@@ -341,17 +360,13 @@ class GBDT:
         """Per-class gradients [K, n_pad] (ref: gbdt.cpp:220 Boosting)."""
         obj = self.objective
         if getattr(obj, "run_on_host", False):
-            score_h = np.asarray(self.scores[0])[:self.num_data].astype(np.float64)
+            score_h = np.asarray(self._slice_row_fn(
+                self.scores, 0))[:self.num_data].astype(np.float64)
             g, h = obj.get_gradients_host(score_h)
             grad = jnp.asarray(_pad_rows(g, self.n_pad))[None, :]
             hess = jnp.asarray(_pad_rows(h, self.n_pad))[None, :]
             return grad, hess
-        K = self.num_tree_per_iteration
-        if K > 1 and obj.num_model_per_iteration() == K:
-            g, h = self._grad_fn(self.scores)
-            return g, h
-        g, h = self._grad_fn(self.scores[0])
-        return g[None, :], h[None, :]
+        return self._grad_fn(self.scores)
 
     def _update_bagging(self, grad=None, hess=None):
         """Row sampling per iteration.  Bagging is a row mask (ref:
@@ -427,7 +442,8 @@ class GBDT:
             tree = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
                 arrays, leaf_id = self._grow_fn(
-                    self.binned_dev, grad[k], hess[k], bag_mask,
+                    self.binned_dev, self._slice_row_fn(grad, k),
+                    self._slice_row_fn(hess, k), bag_mask,
                     self._col_mask(), self.meta, self.grow_params)
                 tree = self._finalize_tree(arrays, leaf_id, k, init_scores[k])
             if tree is None:

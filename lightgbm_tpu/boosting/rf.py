@@ -81,7 +81,8 @@ class RF(GBDT):
             leaf_id = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
                 arrays, leaf_id = self._grow_fn(
-                    self.binned_dev, grad[k], hess[k], bag_mask,
+                    self.binned_dev, self._slice_row_fn(grad, k),
+                    self._slice_row_fn(hess, k), bag_mask,
                     self._col_mask(), self.meta, self.grow_params)
                 tree = self._arrays_to_tree(arrays)
             if tree is not None:
