@@ -33,6 +33,7 @@ class DART(GBDT):
         """Drop before the caller reads training scores, once per iteration
         (ref: dart.hpp:77 GetTrainingScore / is_update_score_cur_iter_)."""
         if not self._dropped_cur_iter:
+            self._sync_model()  # dropping reads host trees
             self._dropping_trees()
             self._dropped_cur_iter = True
 

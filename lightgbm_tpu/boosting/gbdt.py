@@ -10,6 +10,7 @@ raw-feature prediction.
 
 from __future__ import annotations
 
+import copy as _copy
 import functools
 from typing import List, Optional, Sequence
 
@@ -29,6 +30,9 @@ from ..utils import log
 
 K_EPSILON = 1e-15
 _PAD = 1024  # row padding multiple (histogram chunking requirement)
+
+# sentinel stored in models_ for device trees not yet pulled to host
+_PENDING_TREE = object()
 
 
 @functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
@@ -98,6 +102,8 @@ class GBDT:
         self.train_data: Optional[Dataset] = None
         self.objective: Optional[ObjectiveFunction] = None
         self.best_iteration = -1
+        self._pending = []       # device trees awaiting host materialization
+        self._stump_idxs = set()  # model indices of no-split trees
 
     # ------------------------------------------------------------------ init
     def init(self, config: Config, train_data: Dataset,
@@ -177,9 +183,9 @@ class GBDT:
             # (ref: gpu_tree_learner.h:79 single-precision default).
             hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
                          if jax.default_backend() == "tpu" else "segment"))
-        # growth engine: wave (level-batched, TPU-fast for small leaf
-        # counts — its dense slot one-hot pays num_leaves MACs per row-bin)
-        # vs strict leaf-wise (partitioned segments, n*log(L) row visits)
+        # growth engine: wave (level-batched; one MXU histogram sweep per
+        # round with leaf slots as the matmul's output columns) vs strict
+        # leaf-wise (partitioned segments; the reference-parity order)
         from ..ops.histogram import wave_pallas_vmem_ok
         strategy = config.tpu_growth_strategy
         if strategy not in ("auto", "wave", "leafwise"):
@@ -187,7 +193,7 @@ class GBDT:
                       "expected auto, wave, or leafwise")
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"
-                        and 8 <= config.num_leaves <= 64
+                        and config.num_leaves >= 8
                         and self.grow_params.hist_method == "pallas"
                         and wave_pallas_vmem_ok(len(nb), max_b,
                                                 config.num_leaves)
@@ -255,12 +261,40 @@ class GBDT:
                              jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
             return scores.at[class_id].add(delta * pad_mask)
         self._score_update_fn = _score_update
+
+        @jax.jit
+        def _pack_tree(t):
+            # single flat f32 buffer so the host pulls the whole tree in ONE
+            # D2H transfer (each transfer pays a ~11ms round trip on the
+            # remote-TPU runtime); int arrays ride along bit-exactly via
+            # bitcast (mirrors CUDATree::ToHost's batched copy,
+            # ref: src/io/cuda/cuda_tree.cpp)
+            as_f32 = lambda a: jax.lax.bitcast_convert_type(
+                a.astype(jnp.int32), jnp.float32)
+            return jnp.concatenate([
+                as_f32(t.num_leaves[None]),
+                as_f32(t.split_feature), as_f32(t.threshold_bin),
+                as_f32(t.default_left), t.split_gain,
+                as_f32(t.left_child), as_f32(t.right_child),
+                t.internal_value, t.internal_weight,
+                as_f32(t.internal_count),
+                t.leaf_value, t.leaf_weight, as_f32(t.leaf_count),
+                as_f32(t.leaf_parent), as_f32(t.leaf_depth)])
+        self._pack_tree_fn = _pack_tree
         # hot-path helpers kept inside jit (eager device ops are ~100ms
         # each through the remote-TPU tunnel)
         self._slice_row_fn = jax.jit(
             lambda a, k: jax.lax.dynamic_index_in_dim(a, k, 0,
                                                       keepdims=False))
         self._score_add_fn = jax.jit(lambda sc, k, v: sc.at[k].add(v))
+
+        @jax.jit
+        def _score_update_shrink(scores, class_id, leaf_vals, rate,
+                                 leaf_id, pad_mask):
+            delta = jnp.take(leaf_vals * rate,
+                             jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
+            return scores.at[class_id].add(delta * pad_mask)
+        self._score_update_shrink_fn = _score_update_shrink
         self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._ones_col_mask = jnp.ones(len(nb), bool)
@@ -293,7 +327,8 @@ class GBDT:
         """Continued training: adopt prev's trees and seed train/valid scores
         with its predictions (ref: application.cpp:94-97 init score from
         input_model; gbdt.h:70 MergeFrom)."""
-        import copy as _copy
+        if hasattr(prev, "_sync_model"):
+            prev._sync_model()
         K = self.num_tree_per_iteration
         if prev.num_tree_per_iteration != K:
             log.fatal("Cannot continue training: the initial model has "
@@ -447,36 +482,132 @@ class GBDT:
                     self._col_mask(), self.meta, self.grow_params)
                 tree = self._finalize_tree(arrays, leaf_id, k, init_scores[k])
             if tree is None:
-                tree = Tree(2)
-                tree.num_leaves = 1
                 if len(self.models_) < K:
-                    if (self.objective is not None
-                            and not self.config.boost_from_average
-                            and not self.has_init_score):
-                        init_scores[k] = self.objective.boost_from_score(k)
-                        self.scores = self.scores.at[k].add(init_scores[k])
-                        for sc in self.valid_scores:
-                            sc[k] += init_scores[k]
-                    tree.leaf_value[0] = init_scores[k]
-                    tree.shrinkage = 1.0
+                    tree = self._make_const_stump(k)
+                else:
+                    tree = Tree(2)
+                    tree.num_leaves = 1
             else:
                 should_continue = True
             self.models_.append(tree)
 
         if not should_continue:
-            log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
-            if len(self.models_) > K:
-                del self.models_[-K:]
-            return True
+            return self._stop_training(len(self.models_) // K - 1)
+        # keep a short materialization pipeline: drain down to 2 in-flight
+        # trees each iteration.  The oldest buffers have settled by then, so
+        # the pull is a cheap transfer; probing readiness instead
+        # (is_ready) costs a tunnel RPC per probe and deep queues degrade
+        # the remote runtime, so neither polling nor unbounded async works.
+        self._drain_pending(keep_depth=2)
+        stop_iter = self._all_stump_iteration()
+        if stop_iter is not None:
+            return self._stop_training(stop_iter)
         self.iter_ += 1
         return False
 
+    def _all_stump_iteration(self) -> Optional[int]:
+        """First iteration whose K drained trees ALL grew no split (the
+        reference's stop condition; a single class stalling only yields a
+        stump for that class, ref: gbdt.cpp:395-418)."""
+        K = self.num_tree_per_iteration
+        for it in sorted({idx // K for idx in self._stump_idxs}):
+            if all(it * K + k in self._stump_idxs for k in range(K)):
+                return it
+        return None
+
+    def _make_const_stump(self, k: int) -> Tree:
+        """Constant one-leaf tree for a class with no first-iteration split
+        (boost_from_score when averages were not applied; ref:
+        gbdt.cpp:372-391)."""
+        tree = Tree(2)
+        tree.num_leaves = 1
+        init = 0.0
+        if (self.objective is not None
+                and not self.config.boost_from_average
+                and not self.has_init_score):
+            init = self.objective.boost_from_score(k)
+            self.scores = self._score_add_fn(self.scores, k, init)
+            for sc in self.valid_scores:
+                sc[k] += init
+        tree.leaf_value[0] = init
+        tree.shrinkage = 1.0
+        return tree
+
+    def _stop_training(self, stop_iter: int) -> bool:
+        """Reference stop semantics: drop the iteration that failed to split
+        and everything after it (ref: gbdt.cpp:338-418 TrainOneIter's
+        no-split handling), then report stop."""
+        K = self.num_tree_per_iteration
+        self._drain_pending(keep_depth=0)
+        self._stump_idxs.clear()
+        log.warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+        # trees past the stop point already contributed to the device
+        # scores (the pipelined update runs a couple of iterations ahead);
+        # revert them so scores stay consistent with the kept model
+        for idx in range(stop_iter * K, len(self.models_)):
+            tree = self.models_[idx]
+            if isinstance(tree, Tree) and tree.num_leaves > 1:
+                neg = _copy.deepcopy(tree)
+                neg.leaf_value[:neg.num_leaves] *= -1.0
+                self._add_tree_score(neg, idx % K, train=True, valid=False)
+        if stop_iter > 0:
+            del self.models_[stop_iter * K:]
+            self.iter_ = stop_iter
+        else:
+            # first iteration: keep constant stumps (boost_from_score)
+            del self.models_[K:]
+            self.iter_ = 0
+            for k in range(K):
+                tree = self.models_[k]
+                if not isinstance(tree, Tree) or tree.num_leaves > 1:
+                    self.models_[k] = self._make_const_stump(k)
+        return True
+
     def _arrays_to_tree(self, arrays) -> Optional[Tree]:
         """Device TreeArrays -> host Tree (pure conversion; one batched D2H
-        transfer of the whole tree pytree, like CUDATree::ToHost,
+        transfer of the whole tree as a flat buffer, like CUDATree::ToHost,
         ref: src/io/cuda/cuda_tree.cpp)."""
-        arrays = jax.device_get(arrays)
+        return self._packed_to_tree(np.asarray(self._pack_tree_fn(arrays)))
+
+    def _packed_to_tree(self, flat: np.ndarray) -> Optional[Tree]:
+        """Decode the packed flat tree buffer into a host Tree."""
+        ints = flat.view(np.int32)
+        L = self.config.num_leaves
+        ni = max(L - 1, 1)
+        parts = []
+        off = 1
+        for size, arr_ints in ((ni, True), (ni, True), (ni, True),
+                               (ni, False), (ni, True), (ni, True),
+                               (ni, False), (ni, False), (ni, True),
+                               (L, False), (L, False), (L, True),
+                               (L, True), (L, True)):
+            parts.append(ints[off:off + size] if arr_ints
+                         else flat[off:off + size])
+            off += size
+        (split_feature, threshold_bin, default_left, split_gain,
+         left_child, right_child, internal_value, internal_weight,
+         internal_count, leaf_value, leaf_weight, leaf_count,
+         leaf_parent, leaf_depth) = parts
+
+        class _Host:  # attribute-compatible host view of TreeArrays
+            pass
+        arrays = _Host()
+        arrays.num_leaves = ints[0]
+        arrays.split_feature = split_feature
+        arrays.threshold_bin = threshold_bin
+        arrays.default_left = default_left != 0
+        arrays.split_gain = split_gain
+        arrays.left_child = left_child
+        arrays.right_child = right_child
+        arrays.internal_value = internal_value
+        arrays.internal_weight = internal_weight
+        arrays.internal_count = internal_count
+        arrays.leaf_value = leaf_value
+        arrays.leaf_weight = leaf_weight
+        arrays.leaf_count = leaf_count
+        arrays.leaf_parent = leaf_parent
+        arrays.leaf_depth = leaf_depth
         num_leaves = int(arrays.num_leaves)
         if num_leaves <= 1:
             return None
@@ -515,8 +646,32 @@ class GBDT:
         return tree
 
     def _finalize_tree(self, arrays, leaf_id, class_id: int,
-                       init_score: float) -> Optional[Tree]:
-        """Host Tree + renew/shrink/score-update (ref: gbdt.cpp:395-407)."""
+                       init_score: float):
+        """Renew/shrink/score-update after growing (ref: gbdt.cpp:395-407).
+
+        Fast path: every host sync on a fresh device result costs ~100ms on
+        the remote-TPU runtime, so when no host-side tree work is needed
+        this iteration (no renewal objective, no valid sets), the score
+        update runs device-side with shrinkage fused and the host Tree is
+        materialized LATER from a pending queue (_drain_pending) once its
+        packed buffer has settled — the boosting loop never blocks on D2H.
+        """
+        obj = self.objective
+        need_sync = ((obj is not None and obj.need_renew_tree_output)
+                     or bool(self.valid_sets))
+        if not need_sync:
+            packed = self._pack_tree_fn(arrays)
+            copy_async = getattr(packed, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+            self._pending.append(dict(
+                packed=packed, idx=len(self.models_),
+                init=init_score, rate=self.shrinkage_rate))
+            self.scores = self._score_update_shrink_fn(
+                self.scores, class_id, arrays.leaf_value,
+                self.shrinkage_rate, leaf_id, self.pad_mask)
+            return _PENDING_TREE
+
         tree = self._arrays_to_tree(arrays)
         if tree is None:
             return None
@@ -548,6 +703,34 @@ class GBDT:
         if abs(init_score) > K_EPSILON:
             tree.add_bias(init_score)
         return tree
+
+    def _drain_pending(self, keep_depth: int = 0) -> None:
+        """Materialize pending device trees oldest-first until at most
+        keep_depth remain in flight."""
+        while len(self._pending) > keep_depth:
+            p = self._pending.pop(0)
+            tree = self._packed_to_tree(np.asarray(p["packed"]))
+            if tree is None:
+                # grew no split: keep a 0-value stump for this class (ref:
+                # gbdt.cpp:372-391) and record it for the stop condition
+                self._stump_idxs.add(p["idx"])
+                tree = Tree(2)
+                tree.num_leaves = 1
+                tree.shrinkage = 1.0
+                self.models_[p["idx"]] = tree
+            else:
+                tree.apply_shrinkage(p["rate"])
+                if abs(p["init"]) > K_EPSILON:
+                    tree.add_bias(p["init"])
+                self.models_[p["idx"]] = tree
+
+    def _sync_model(self) -> None:
+        """Block until models_ holds real host trees (public consumers —
+        predict/save/eval/rollback — call this first)."""
+        self._drain_pending(keep_depth=0)
+        stop_iter = self._all_stump_iteration()
+        if stop_iter is not None:
+            self._stop_training(stop_iter)
 
     # -------------------------------------------------------- score plumbing
     def _tree_leaf_ids(self, tree: Tree, binned: np.ndarray) -> np.ndarray:
@@ -598,6 +781,7 @@ class GBDT:
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
         """Raw scores [n] or [n, K] (ref: gbdt_prediction.cpp PredictRaw)."""
+        self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
         K = self.num_tree_per_iteration
@@ -628,6 +812,7 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
                            num_iteration: int = -1) -> np.ndarray:
+        self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration
         total_iters = len(self.models_) // K
@@ -650,6 +835,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """ref: gbdt.cpp:443 RollbackOneIter (model-side only; scores are
         rebuilt lazily on next use)."""
+        self._sync_model()
         K = self.num_tree_per_iteration
         if len(self.models_) >= K:
             del self.models_[-K:]
@@ -657,6 +843,7 @@ class GBDT:
 
     # --------------------------------------------------------------- model IO
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        self._sync_model()
         F = self.train_data.num_total_features if self.train_data else (
             max(int(t.split_feature[:t.num_leaves - 1].max(initial=0))
                 for t in self.models_) + 1 if self.models_ else 0)

@@ -48,6 +48,8 @@ def save_model_to_string(booster, num_iteration: int = -1,
                          start_iteration: int = 0,
                          importance_type: str = "split") -> str:
     """ref: gbdt_model_text.cpp GBDT::SaveModelToString."""
+    if hasattr(booster, "_sync_model"):
+        booster._sync_model()
     ds = booster.train_data
     K = booster.num_tree_per_iteration
     cfg = booster.config

@@ -10,31 +10,44 @@ work:
 
   1. one fused multi-leaf Pallas histogram pass over all rows
      (ops/histogram.py build_histogram_wave — all leaves' histograms in one
-     MXU sweep; ref: cuda_histogram_constructor.cu builds per-leaf
-     histograms in shared memory the same way),
-  2. one vmapped gain scan over [L, F, B] (ref:
+     MXU sweep whose output columns are leaf slots; ref:
+     cuda_histogram_constructor.cu builds per-leaf histograms in shared
+     memory the same way),
+  2. one vmapped gain scan over [NLp, F, B] (ref:
      feature_histogram.hpp:192 FindBestThreshold, batched over leaves),
   3. one vectorized recolor pass (rows look up their leaf's split through a
-     single packed [L, 8] table row-gather; ref: dense_bin.hpp:346
+     single packed [NLp, 8] table row-gather; ref: dense_bin.hpp:346
      SplitInner applied to all splitting leaves at once).
+
+The wave loop is UNROLLED over ceil(log2(num_leaves)) rounds with a
+per-round slot bound (8, 16, ..., padded num_leaves), so early rounds pay
+kernels sized to the leaves that actually exist; each round is wrapped in
+lax.cond and skipped once no leaf splits.
 
 Tree shape: identical to leaf-wise when split gains decrease monotonically
 with depth (the common case on real losses); on non-monotone gain
 landscapes leaf-wise may deepen one branch where wave spreads a level, a
 quality-neutral tradeoff (XGBoost's depthwise analogue).  When the
 num_leaves budget binds mid-round only the highest-gain leaves split,
-matching leaf-wise's preference.  All row-axis ops are
-reductions/maps, so the engine shards over a data mesh without changes.
+matching leaf-wise's preference.  All row-axis ops are reductions/maps, so
+the engine shards over a data mesh without changes.
+
+Counts: the per-wave gain scan and the stored tree use EXACT partition
+counts from a third histogram channel accumulating the row mask (the
+reference's DataPartition counts, tree.cpp Tree::Split); the per-bin counts
+inside the scan remain the reference's RoundInt(hess * cnt_factor)
+approximation for parity (feature_histogram.hpp:871-874).
 """
 
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram_wave
+from ..ops.histogram import build_histogram_wave, wave_slot_pad
 from ..ops.split import K_MIN_SCORE, find_best_split
 from .grow import FeatureMeta, GrowParams, TreeArrays
 
@@ -69,17 +82,17 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     row_mask = row_mask.astype(f32)
     grad = grad.astype(f32) * row_mask
     hess = hess.astype(f32) * row_mask
-    gh = jnp.stack([grad, hess], axis=1)
+    # channel 2 accumulates the row mask -> exact per-leaf counts
+    gh = jnp.stack([grad, hess, row_mask], axis=1)
 
-    from ..ops.histogram import wave_pallas_vmem_ok
-    use_pallas = (params.hist_method == "pallas"
-                  and wave_pallas_vmem_ok(num_features, B, L))
+    use_pallas = params.hist_method == "pallas"
 
-    def hists_of(leaf_id):
+    def hists_of(leaf_id, num_slots):
         if use_pallas:
             return build_histogram_wave(binned, leaf_id, gh,
-                                        max_bin=B, num_slots=L)
-        return _hist_wave_xla(binned, leaf_id, gh, max_bin=B, num_slots=L)
+                                        max_bin=B, num_slots=num_slots)
+        return _hist_wave_xla(binned, leaf_id, gh, max_bin=B,
+                              num_slots=num_slots)
 
     best_vm = jax.vmap(
         lambda h, sg, sh, c, po: find_best_split(
@@ -91,6 +104,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     cnt0 = jnp.sum(row_mask).astype(i32)
 
     ni = max(L - 1, 1)
+    # leaf-indexed arrays are sized to the padded slot bound (>= L) so
+    # static [:NLp] slices stay in range; sliced back to [L] on return
+    Lp = wave_slot_pad(L)
     tree = TreeArrays(
         num_leaves=jnp.asarray(1, i32),
         split_feature=jnp.zeros(ni, i32),
@@ -102,37 +118,40 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         internal_value=jnp.zeros(ni, f32),
         internal_weight=jnp.zeros(ni, f32),
         internal_count=jnp.zeros(ni, i32),
-        leaf_value=jnp.zeros(L, f32),
-        leaf_weight=jnp.zeros(L, f32).at[0].set(sum_h0),
-        leaf_count=jnp.zeros(L, i32).at[0].set(cnt0),
-        leaf_parent=jnp.full(L, -1, i32),
-        leaf_depth=jnp.zeros(L, i32))
+        leaf_value=jnp.zeros(Lp, f32),
+        leaf_weight=jnp.zeros(Lp, f32).at[0].set(sum_h0),
+        leaf_count=jnp.zeros(Lp, i32).at[0].set(cnt0),
+        leaf_parent=jnp.full(Lp, -1, i32),
+        leaf_depth=jnp.zeros(Lp, i32))
 
     # per-leaf running sums / outputs for the gain scan
-    leaf_sum_g0 = jnp.zeros(L, f32).at[0].set(sum_g0)
-    leaf_sum_h0 = jnp.zeros(L, f32).at[0].set(sum_h0)
-    leaf_out0 = jnp.zeros(L, f32)
+    leaf_sum_g0 = jnp.zeros(Lp, f32).at[0].set(sum_g0)
+    leaf_sum_h0 = jnp.zeros(Lp, f32).at[0].set(sum_h0)
+    leaf_out0 = jnp.zeros(Lp, f32)
 
-    def round_body(state):
+    def wave_body(state, NLp):
+        """One wave with a static slot bound NLp >= current num_leaves."""
         (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, _) = state
         NL = tree.num_leaves
 
-        # 1. all leaves' histograms in one pass
-        hists = hists_of(leaf_id)                     # [L, F, B, 2]
-        active = jnp.arange(L, dtype=i32) < NL
-        best = best_vm(hists, leaf_sum_g, leaf_sum_h,
-                       tree.leaf_count, leaf_out)     # SplitResult over [L]
+        # 1. all leaves' histograms in one pass; channel 2 = exact counts
+        hists = hists_of(leaf_id, NLp)                # [NLp, F, B, 3]
+        counts = jnp.round(jnp.sum(hists[:, 0, :, 2], axis=1)).astype(i32)
+        active = jnp.arange(NLp, dtype=i32) < NL
+        best = best_vm(hists[..., :2], leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                       counts, leaf_out[:NLp])        # SplitResult over [NLp]
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget
         gain = jnp.where(active, best.gain, K_MIN_SCORE)
         if params.max_depth > 0:
-            gain = jnp.where(tree.leaf_depth < params.max_depth,
+            gain = jnp.where(tree.leaf_depth[:NLp] < params.max_depth,
                              gain, K_MIN_SCORE)
         want = gain > 0.0
         budget = L - NL
         order = jnp.argsort(-gain)                    # best first
-        rank_of = jnp.zeros(L, i32).at[order].set(jnp.arange(L, dtype=i32))
+        rank_of = jnp.zeros(NLp, i32).at[order].set(
+            jnp.arange(NLp, dtype=i32))
         split_sel = want & (rank_of < budget)
         n_split = jnp.sum(split_sel.astype(i32))
 
@@ -147,39 +166,42 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         def fix_child(child):
             ll = jnp.where(child < 0, ~child, 0)
             is_leaf_ref = (child < 0) & (jnp.arange(ni) < NL - 1)
-            repl = jnp.take(node_of, jnp.clip(ll, 0, L - 1))
-            hit = is_leaf_ref & jnp.take(split_sel, jnp.clip(ll, 0, L - 1))
+            repl = jnp.take(node_of, jnp.clip(ll, 0, NLp - 1))
+            hit = is_leaf_ref & jnp.take(split_sel, jnp.clip(ll, 0, NLp - 1))
             return jnp.where(hit, repl, child)
         left_child = fix_child(t.left_child)
         right_child = fix_child(t.right_child)
 
         # scatter per-splitting-leaf node records
-        sl_nodes = node_of                             # [L] targets
+        sl_nodes = node_of                             # [NLp] targets
         drop = jnp.where(split_sel, sl_nodes, ni)      # OOB -> dropped
         def nset(arr, vals):
             return arr.at[drop].set(vals, mode="drop")
         left_child = nset(left_child,
-                          ~jnp.arange(L, dtype=i32))   # left child = old leaf
+                          ~jnp.arange(NLp, dtype=i32))  # left = old leaf
         right_child = nset(right_child, ~newleaf_of)
         split_feature = nset(t.split_feature, best.feature)
         threshold_bin = nset(t.threshold_bin, best.threshold)
         default_left = nset(t.default_left, best.default_left)
         split_gain = nset(t.split_gain, best.gain)
-        internal_value = nset(t.internal_value, t.leaf_value)
+        internal_value = nset(t.internal_value, t.leaf_value[:NLp])
         internal_weight = nset(t.internal_weight,
                                best.left_sum_hessian + best.right_sum_hessian)
-        internal_count = nset(t.internal_count, t.leaf_count)  # exact
+        internal_count = nset(t.internal_count, counts)  # exact
 
         # leaf records: old slot becomes the left child, new slot the right
-        ldrop = jnp.where(split_sel, jnp.arange(L, dtype=i32), L)
-        rdrop = jnp.where(split_sel, newleaf_of, L)
-        depth1 = t.leaf_depth + 1
+        ldrop = jnp.where(split_sel, jnp.arange(NLp, dtype=i32), Lp)
+        rdrop = jnp.where(split_sel, newleaf_of, Lp)
+        depth1 = t.leaf_depth[:NLp] + 1
         def lset(arr, lvals, rvals):
             return (arr.at[ldrop].set(lvals, mode="drop")
                     .at[rdrop].set(rvals, mode="drop"))
         leaf_value = lset(t.leaf_value, best.left_output, best.right_output)
         leaf_weight = lset(t.leaf_weight, best.left_sum_hessian,
                            best.right_sum_hessian)
+        # leaf_count here is the scan's approximation; the exact counts are
+        # restored from the count channel each wave and at finalization
+        leaf_count = lset(t.leaf_count, best.left_count, best.right_count)
         leaf_parent = lset(t.leaf_parent, sl_nodes, sl_nodes)
         leaf_depth = lset(t.leaf_depth, depth1, depth1)
         leaf_sum_g = lset(leaf_sum_g, best.left_sum_gradient,
@@ -188,13 +210,24 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           best.right_sum_hessian)
         leaf_out = lset(leaf_out, best.left_output, best.right_output)
 
-        # 4. recolor rows: one packed [L, 8] table row-gather per row
+        tree = TreeArrays(
+            num_leaves=NL + n_split,
+            split_feature=split_feature, threshold_bin=threshold_bin,
+            default_left=default_left, split_gain=split_gain,
+            left_child=left_child, right_child=right_child,
+            internal_value=internal_value, internal_weight=internal_weight,
+            internal_count=internal_count,
+            leaf_value=leaf_value, leaf_weight=leaf_weight,
+            leaf_count=leaf_count, leaf_parent=leaf_parent,
+            leaf_depth=leaf_depth)
+
+        # 4. recolor rows: one packed [NLp, 8] table row-gather per row
         packed = jnp.stack(
             [split_sel.astype(i32), best.feature, best.threshold,
              best.default_left.astype(i32), newleaf_of,
              jnp.take(meta.missing_type, best.feature),
              jnp.take(meta.default_bin, best.feature),
-             jnp.take(meta.num_bin, best.feature)], axis=1)  # [L, 8]
+             jnp.take(meta.num_bin, best.feature)], axis=1)  # [NLp, 8]
         prow = jnp.take(packed, leaf_id, axis=0)             # [n, 8]
         sel_r = prow[:, 0] > 0
         feat_r = prow[:, 1]
@@ -213,35 +246,34 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         go_left = jnp.where(is_missing, dleft_r, fbin <= thr_r)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
 
-        # exact leaf counts from the new partition (the scan's counts are
-        # the reference's hess*cnt_factor RoundInt approximation; the Tree
-        # stores DataPartition's exact counts, ref: tree.cpp Tree::Split
-        # leaf_count_ from cnt_leaf_data) — also fed back to the next
-        # round's gain scan as num_data
-        leaf_count = (jnp.zeros(L, f32).at[leaf_id].add(row_mask)
-                      .astype(i32))
+        cont = (n_split > 0) & (tree.num_leaves < L)
+        return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, cont)
 
-        tree = TreeArrays(
-            num_leaves=NL + n_split,
-            split_feature=split_feature, threshold_bin=threshold_bin,
-            default_left=default_left, split_gain=split_gain,
-            left_child=left_child, right_child=right_child,
-            internal_value=internal_value, internal_weight=internal_weight,
-            internal_count=internal_count,
-            leaf_value=leaf_value, leaf_weight=leaf_weight,
-            leaf_count=leaf_count, leaf_parent=leaf_parent,
-            leaf_depth=leaf_depth)
+    state = (tree, jnp.zeros(n, i32), leaf_sum_g0, leaf_sum_h0, leaf_out0,
+             jnp.asarray(L > 1))
+    num_waves = max(1, math.ceil(math.log2(L))) if L > 1 else 0
+    for k in range(num_waves):
+        NLp = wave_slot_pad(min(1 << k, L))
+        state = jax.lax.cond(state[5],
+                             functools.partial(wave_body, NLp=NLp),
+                             lambda s: s, state)
+    if num_waves > 0:
+        # growth slower than doubling (chain-shaped gain landscapes) needs
+        # more rounds than the unrolled ladder: keep waving at the full
+        # slot bound until no leaf splits or the budget is exhausted
+        state = jax.lax.while_loop(
+            lambda s: s[5],
+            functools.partial(wave_body, NLp=wave_slot_pad(L)), state)
 
-        return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, n_split)
-
-    def cond(state):
-        tree = state[0]
-        return (state[5] > 0) & (tree.num_leaves < L)
-
-    state0 = (tree, jnp.zeros(n, i32), leaf_sum_g0, leaf_sum_h0, leaf_out0,
-              jnp.asarray(1, i32))
-    if L > 1:
-        state = jax.lax.while_loop(cond, round_body, state0)
-    else:
-        state = state0
-    return state[0], state[1]
+    tree, leaf_id = state[0], state[1]
+    if num_waves > 0:
+        # exact final counts from the final partition (one scatter-add;
+        # ref: DataPartition cnt_leaf_data)
+        exact = (jnp.zeros(Lp, f32).at[leaf_id].add(row_mask)).astype(i32)
+        tree = tree._replace(leaf_count=exact)
+    if Lp != L:  # back to the caller-visible [L] leaf layout
+        tree = tree._replace(
+            leaf_value=tree.leaf_value[:L], leaf_weight=tree.leaf_weight[:L],
+            leaf_count=tree.leaf_count[:L], leaf_parent=tree.leaf_parent[:L],
+            leaf_depth=tree.leaf_depth[:L])
+    return tree, leaf_id

@@ -144,33 +144,40 @@ def build_histogram_rows_pallas(rows: jnp.ndarray, gh: jnp.ndarray,
     return out[:F, :max_bin, :]                       # [F, B, C]
 
 
-def _wave_kernel(Fg: int, Bp: int, NL: int):
-    """Multi-leaf fused histogram kernel for wave (level-batched) growth:
-    per row tile, build per-feature-group one-hots [Fg*Bp, Rt] and a
-    per-leaf-slot gh matrix [Rt, NL] in VMEM, then one MXU dot per group
-    and channel yields all leaves' histograms at once — the TPU replacement
-    for the CUDA per-leaf shared-memory kernels
-    (ref: cuda_histogram_constructor.cu:18)."""
+def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
+    """Multi-leaf fused histogram kernel for wave (level-batched) growth.
+
+    Per (slot-group, bin-group, feature-group, row-tile) grid cell, build
+    the [Fg, Bg, Rt] bin one-hot and the slot-separated channel matrices
+    [Rt, NLg] in VMEM, then one MXU dot per channel accumulates all NLg
+    leaves' histograms at once.  The leaf-slot axis is what fills the MXU's
+    128-wide output dimension — a plain per-leaf histogram dot has C=2..3
+    output columns and idles 125/128 of the systolic array, which is the
+    dominant cost of histogram construction on TPU.  (TPU replacement for
+    the CUDA per-leaf shared-memory kernels,
+    ref: cuda_histogram_constructor.cu:18.)"""
     def kernel(rows_ref, slot_ref, gh_ref, out_ref):
-        @pl.when(pl.program_id(1) == 0)
+        @pl.when(pl.program_id(3) == 0)
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
+        s = pl.program_id(0)
+        bg = pl.program_id(1)
         rows = rows_ref[...].astype(jnp.int32)           # [Fg, Rt]
         slot = slot_ref[...].astype(jnp.int32)           # [Rt, 1]
-        gh = gh_ref[...]                                 # [Rt, 2]
+        gh = gh_ref[...]                                 # [Rt, C]
         Rt = rows.shape[1]
-        soh = (slot == jax.lax.broadcasted_iota(jnp.int32, (Rt, NL), 1))
-        sg = soh.astype(jnp.bfloat16) * gh[:, 0:1].astype(jnp.bfloat16)
-        sh = soh.astype(jnp.bfloat16) * gh[:, 1:2].astype(jnp.bfloat16)
-        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bp, Rt), 1)
+        loc = slot - s * NLg
+        soh = (loc == jax.lax.broadcasted_iota(jnp.int32, (Rt, NLg), 1))
+        biota = (jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
+                 + bg * Bg)
         oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
-        oh2 = oh.reshape(Fg * Bp, Rt)
-        accg = jax.lax.dot_general(oh2, sg, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        acch = jax.lax.dot_general(oh2, sh, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        out_ref[0] += accg.reshape(Fg, Bp, NL)
-        out_ref[1] += acch.reshape(Fg, Bp, NL)
+        oh2 = oh.reshape(Fg * Bg, Rt)
+        for c in range(C):
+            sc = soh.astype(jnp.bfloat16) * gh[:, c:c + 1].astype(jnp.bfloat16)
+            acc = jax.lax.dot_general(
+                oh2, sc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [Fg*Bg, NLg]
+            out_ref[c] += acc.reshape(Fg, Bg, NLg)
     return kernel
 
 
@@ -184,41 +191,53 @@ def _pick_feature_group(Fp: int, unit_bytes: int, budget: int) -> int:
     return Fg
 
 
+def wave_slot_pad(num_slots: int) -> int:
+    """Slot-axis padding for the wave kernel: the out block's last dim must
+    be a multiple of 128 or the whole (padded) slot axis."""
+    if num_slots <= 128:
+        return max(8, (num_slots + 7) // 8 * 8)
+    return (num_slots + 127) // 128 * 128
+
+
 def wave_pallas_vmem_ok(num_features: int, max_bin: int,
                         num_slots: int) -> bool:
-    """True when the wave kernel fits TPU VMEM: the per-group accumulator at
-    the smallest legal (8-aligned) feature group, AND the full output array
-    — XLA may scope a pallas result into VMEM when its consumer is fused."""
-    Bp = (max_bin + 127) // 128 * 128
-    NLp = max(8, (num_slots + 7) // 8 * 8)
-    Fp = (num_features + 7) // 8 * 8
-    return (2 * 8 * Bp * NLp * 4 <= (4 << 20)
-            and 2 * Fp * Bp * NLp * 4 <= (6 << 20))
+    """True when the wave kernel's VMEM accumulator fits at the smallest
+    legal tile (Fg=8, Bg<=128, NLg<=128, 3 channels)."""
+    Bg = min((max_bin + 7) // 8 * 8, 128)
+    NLg = min(wave_slot_pad(num_slots), 128)
+    return 3 * 8 * Bg * NLg * 4 <= (8 << 20)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("max_bin", "num_slots", "row_tile"))
 def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
                          gh: jnp.ndarray, *, max_bin: int, num_slots: int,
-                         row_tile: int = 512) -> jnp.ndarray:
-    """Histograms for all leaf slots in one pass.
+                         row_tile: int = 256) -> jnp.ndarray:
+    """Histograms for all leaf slots in one fused pass over the rows.
 
-    The dense slot one-hot matmul pays NLp MACs per (row, feature, bin), so
-    this kernel is for the small-leaf-count regime; callers gate on
-    wave_pallas_vmem_ok and leaf count (gbdt.py growth-strategy dispatch).
+    Grid = (slot groups, bin groups, feature groups, row tiles); each cell
+    is one MXU dot whose output columns are leaf slots, so the pass costs
+    the same MXU cycles as ONE plain histogram per 128 slots — the N-dim
+    filling trick that makes level-batched growth pay ~n*F*B cycles per
+    wave instead of per split.
 
     Args:
       binned_fm: [F, n] feature-major bin codes.
-      slot: [n] int32 leaf slot per row (use num_slots-1+garbage for rows
-        that must not contribute, with gh zeroed by the mask).
-      gh: [n, 2] per-row gradient/hessian (already masked).
+      slot: [n] int32 leaf slot per row (rows that must not contribute
+        carry zeroed gh channels).
+      gh: [n, C] per-row accumulands (gradient, hessian, count-mask, ...).
       max_bin: B (static).  num_slots: NL leaf slots (static).
 
-    Returns: [NL, F, B, 2] float32.
+    Returns: [NL, F, B, C] float32.
     """
     F, n = binned_fm.shape
-    Bp = (max_bin + 127) // 128 * 128
-    NLp = max(8, (num_slots + 7) // 8 * 8)
+    C = gh.shape[-1]
+    NLp = wave_slot_pad(num_slots)
+    NLg = min(NLp, 128)
+    Bp = max(8, (max_bin + 7) // 8 * 8)
+    Bg = min(Bp, 128)
+    if Bp % Bg != 0:
+        Bp = (Bp + Bg - 1) // Bg * Bg
     if n % row_tile != 0:
         raise ValueError(f"n {n} not a multiple of row_tile {row_tile}")
     # TPU block constraint: the binned block's second-to-last dim (Fg) must
@@ -226,18 +245,20 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     Fp = (F + 7) // 8 * 8
     if Fp != F:
         binned_fm = jnp.pad(binned_fm, ((0, Fp - F), (0, 0)))
-    # feature group size bounded by the VMEM accumulator [2, Fg, Bp, NLp]
-    Fg = _pick_feature_group(Fp, 2 * Bp * NLp * 4, 4 << 20)
+    # feature group bounded by the VMEM accumulator [C, Fg, Bg, NLg]
+    Fg = _pick_feature_group(Fp, C * Bg * NLg * 4, 4 << 20)
     out = pl.pallas_call(
-        _wave_kernel(Fg, Bp, NLp),
-        grid=(Fp // Fg, n // row_tile),
-        in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
-                  pl.BlockSpec((row_tile, 1), lambda g, i: (i, 0)),
-                  pl.BlockSpec((row_tile, 2), lambda g, i: (i, 0))],
-        out_specs=pl.BlockSpec((2, Fg, Bp, NLp), lambda g, i: (0, g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, Fp, Bp, NLp), jnp.float32),
+        _wave_kernel(C, Fg, Bg, NLg),
+        grid=(NLp // NLg, Bp // Bg, Fp // Fg, n // row_tile),
+        in_specs=[
+            pl.BlockSpec((Fg, row_tile), lambda s, bg, g, i: (g, i)),
+            pl.BlockSpec((row_tile, 1), lambda s, bg, g, i: (i, 0)),
+            pl.BlockSpec((row_tile, C), lambda s, bg, g, i: (i, 0))],
+        out_specs=pl.BlockSpec((C, Fg, Bg, NLg),
+                               lambda s, bg, g, i: (0, g, bg, s)),
+        out_shape=jax.ShapeDtypeStruct((C, Fp, Bp, NLp), jnp.float32),
     )(binned_fm, slot.reshape(n, 1), gh)
-    # [2, Fp, Bp, NLp] -> [NL, F, B, 2]
+    # [C, Fp, Bp, NLp] -> [NL, F, B, C]
     return out.transpose(3, 1, 2, 0)[:num_slots, :F, :max_bin, :]
 
 
