@@ -66,13 +66,20 @@ def _pad_rows(arr: np.ndarray, n_pad: int, axis: int = -1, fill=0):
 def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
                          left_child, right_child, num_leaves,
                          missing_type, num_bin, default_bin,
-                         binned: np.ndarray) -> np.ndarray:
+                         binned: np.ndarray, is_cat_node=None,
+                         cat_boundaries_inner=None,
+                         cat_threshold_inner=None) -> np.ndarray:
     """Vectorized bin-space tree traversal on host (mirror of the device
-    partition rule; ref: dense_bin.hpp:346-366 SplitInner)."""
+    partition rule; ref: dense_bin.hpp:346-366 SplitInner + tree.h:372
+    CategoricalDecision over bin bitsets)."""
     from ..io.binning import MISSING_NAN, MISSING_ZERO
     n = binned.shape[1]
     if num_leaves <= 1:
         return np.zeros(n, dtype=np.int32)
+    has_cat = is_cat_node is not None and np.any(is_cat_node)
+    if has_cat:
+        cb = np.asarray(cat_boundaries_inner, np.int64)
+        ct = np.asarray(cat_threshold_inner, np.uint32)
     node = np.zeros(n, dtype=np.int32)
     for _ in range(num_leaves):
         active = node >= 0
@@ -85,6 +92,16 @@ def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
         is_missing = (((mt == MISSING_NAN) & (b == num_bin[f] - 1))
                       | ((mt == MISSING_ZERO) & (b == default_bin[f])))
         go_left = np.where(is_missing, default_left[nd], b <= threshold_bin[nd])
+        if has_cat:
+            cat_nd = is_cat_node[nd]
+            cat_idx = np.where(cat_nd, threshold_bin[nd], 0)
+            start = cb[cat_idx]
+            nwords = cb[cat_idx + 1] - start
+            word = b.astype(np.int64) // 32
+            ok = word < nwords
+            wv = ct[np.clip(start + word, 0, len(ct) - 1)] if len(ct) else 0
+            cat_left = ok & (((wv >> (b % 32).astype(np.uint32)) & 1) > 0)
+            go_left = np.where(cat_nd, cat_left, go_left)
         node[active] = np.where(go_left, left_child[nd], right_child[nd])
     return (~node).astype(np.int32)
 
@@ -145,9 +162,6 @@ class GBDT:
         self.f_num_bin = np.array(nb, np.int32)
         self.f_default_bin = np.array(db, np.int32)
         self.f_is_cat = np.array(cat, bool)
-        if self.f_is_cat.any():
-            log.warning("categorical splits are trained as numerical in this "
-                        "version (sorted-category scan lands later)")
         penalty = np.ones(len(nb), np.float32)
         if config.feature_contri:
             for i, f in enumerate(train_data.used_features):
@@ -157,7 +171,8 @@ class GBDT:
             num_bin=jnp.asarray(self.f_num_bin),
             missing_type=jnp.asarray(self.f_missing_type),
             default_bin=jnp.asarray(self.f_default_bin),
-            penalty=jnp.asarray(penalty))
+            penalty=jnp.asarray(penalty),
+            is_cat=jnp.asarray(self.f_is_cat))
 
         max_b = int(self.f_num_bin.max()) if len(nb) else 1
         # histogram stack memory guard (HistogramPool analogue)
@@ -174,7 +189,13 @@ class GBDT:
                 min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
                 min_gain_to_split=config.min_gain_to_split,
                 max_delta_step=config.max_delta_step,
-                path_smooth=config.path_smooth),
+                path_smooth=config.path_smooth,
+                has_categorical=bool(self.f_is_cat.any()),
+                cat_features=tuple(np.nonzero(self.f_is_cat)[0].tolist()),
+                max_cat_to_onehot=config.max_cat_to_onehot,
+                max_cat_threshold=config.max_cat_threshold,
+                cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
+                min_data_per_group=config.min_data_per_group),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -279,8 +300,12 @@ class GBDT:
                 t.internal_value, t.internal_weight,
                 as_f32(t.internal_count),
                 t.leaf_value, t.leaf_weight, as_f32(t.leaf_count),
-                as_f32(t.leaf_parent), as_f32(t.leaf_depth)])
+                as_f32(t.leaf_parent), as_f32(t.leaf_depth),
+                as_f32(t.split_is_cat),
+                as_f32(t.cat_bitset.reshape(-1))])
         self._pack_tree_fn = _pack_tree
+        from ..ops.split import cat_bitset_words
+        self._cat_words = cat_bitset_words(max_b)
         # hot-path helpers kept inside jit (eager device ops are ~100ms
         # each through the remote-TPU tunnel)
         self._slice_row_fn = jax.jit(
@@ -575,20 +600,23 @@ class GBDT:
         ints = flat.view(np.int32)
         L = self.config.num_leaves
         ni = max(L - 1, 1)
+        W = self._cat_words
         parts = []
         off = 1
         for size, arr_ints in ((ni, True), (ni, True), (ni, True),
                                (ni, False), (ni, True), (ni, True),
                                (ni, False), (ni, False), (ni, True),
                                (L, False), (L, False), (L, True),
-                               (L, True), (L, True)):
+                               (L, True), (L, True),
+                               (ni, True), (ni * W, True)):
             parts.append(ints[off:off + size] if arr_ints
                          else flat[off:off + size])
             off += size
         (split_feature, threshold_bin, default_left, split_gain,
          left_child, right_child, internal_value, internal_weight,
          internal_count, leaf_value, leaf_weight, leaf_count,
-         leaf_parent, leaf_depth) = parts
+         leaf_parent, leaf_depth, split_is_cat, cat_bits_flat) = parts
+        cat_bits = cat_bits_flat.reshape(ni, W)
 
         class _Host:  # attribute-compatible host view of TreeArrays
             pass
@@ -623,8 +651,20 @@ class GBDT:
         tree.split_feature[:ni] = np.array(
             [ds.used_features[f] for f in sf_inner], np.int32)
         tree.threshold_in_bin[:ni] = thr_bin
+        is_cat_node = split_is_cat[:ni] != 0
         for i in range(ni):
             mapper = ds.bin_mappers[tree.split_feature[i]]
+            if is_cat_node[i]:
+                # decode the device bins-left bitset, then register via the
+                # shared Tree bookkeeping (tree.py register_cat_split)
+                words = cat_bits[i]
+                bins_left = [b for b in range(mapper.num_bin)
+                             if (words[b // 32] >> (b % 32)) & 1]
+                cats_left = [mapper.bin_2_categorical[b] for b in bins_left
+                             if mapper.bin_2_categorical[b] >= 0]
+                tree.register_cat_split(i, bins_left, cats_left,
+                                        mapper.missing_type)
+                continue
             tree.threshold[i] = mapper.bin_to_value(int(thr_bin[i]))
             dt = 0
             if dleft[i]:
@@ -736,12 +776,16 @@ class GBDT:
     def _tree_leaf_ids(self, tree: Tree, binned: np.ndarray) -> np.ndarray:
         """Bin-space leaf index of every row for a tree trained on this
         dataset's bin mappers."""
+        from ..models.tree import K_CATEGORICAL_MASK
         ni = tree.num_leaves - 1
         return leaf_index_bin_space(
             tree.split_feature_inner[:ni], tree.threshold_in_bin[:ni],
             (tree.decision_type[:ni] & 2) > 0,
             tree.left_child[:ni], tree.right_child[:ni], tree.num_leaves,
-            self.f_missing_type, self.f_num_bin, self.f_default_bin, binned)
+            self.f_missing_type, self.f_num_bin, self.f_default_bin, binned,
+            is_cat_node=(tree.decision_type[:ni] & K_CATEGORICAL_MASK) > 0,
+            cat_boundaries_inner=tree.cat_boundaries_inner,
+            cat_threshold_inner=tree.cat_threshold_inner)
 
     def _add_tree_score(self, tree: Tree, class_id: int,
                         train: bool = True, valid: bool = True) -> None:

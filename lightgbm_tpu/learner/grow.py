@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import build_histogram, build_histogram_rows_pallas
-from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult, find_best_split,
+from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
+                         cat_bitset_words, find_best_split,
                          MISSING_NAN, MISSING_ZERO)
 
 
@@ -52,6 +53,7 @@ class FeatureMeta(NamedTuple):
     missing_type: jnp.ndarray   # [F] int32
     default_bin: jnp.ndarray    # [F] int32
     penalty: jnp.ndarray        # [F] float32 (feature_contri)
+    is_cat: jnp.ndarray = None  # [F] bool (None when no categorical)
 
 
 class GrowParams(NamedTuple):
@@ -87,6 +89,8 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray       # [L] int32
     leaf_parent: jnp.ndarray      # [L] int32
     leaf_depth: jnp.ndarray       # [L] int32
+    split_is_cat: jnp.ndarray = None  # [L-1] bool (categorical split)
+    cat_bitset: jnp.ndarray = None    # [L-1, W] int32 bins-left bitsets
 
 
 class _PendingSplits(NamedTuple):
@@ -104,6 +108,8 @@ class _PendingSplits(NamedTuple):
     right_sum_hessian: jnp.ndarray
     right_count: jnp.ndarray
     right_output: jnp.ndarray
+    is_cat: jnp.ndarray          # [L] bool
+    cat_bitset: jnp.ndarray      # [L, W] int32
 
 
 class _State(NamedTuple):
@@ -132,7 +138,9 @@ def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
         right_sum_gradient=p.right_sum_gradient.at[idx].set(res.right_sum_gradient),
         right_sum_hessian=p.right_sum_hessian.at[idx].set(res.right_sum_hessian),
         right_count=p.right_count.at[idx].set(res.right_count),
-        right_output=p.right_output.at[idx].set(res.right_output))
+        right_output=p.right_output.at[idx].set(res.right_output),
+        is_cat=p.is_cat.at[idx].set(res.is_cat),
+        cat_bitset=p.cat_bitset.at[idx].set(res.cat_bitset))
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -183,7 +191,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def best_of(hist, sum_g, sum_h, cnt, parent_out):
         return find_best_split(hist, meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
-                               sum_g, sum_h, cnt, parent_out, sp)
+                               sum_g, sum_h, cnt, parent_out, sp,
+                               is_cat_feature=meta.is_cat)
 
     # pow2 bucket ladder for the partitioned engine; the last bucket covers
     # the whole row range (used by the root split)
@@ -203,12 +212,19 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # hoisted out of the split loop by XLA)
     binned_rows = binned.T if use_partition else None
 
-    def go_left_of(fbins, feat, dleft, thr):
-        """Partition rule in bin space (ref: dense_bin.hpp:346-366 SplitInner)."""
+    def go_left_of(fbins, feat, dleft, thr, isc, bitset):
+        """Partition rule in bin space (ref: dense_bin.hpp:346-366
+        SplitInner; categorical: bin in bitset -> left, ref: tree.h:372
+        CategoricalDecision with the NaN/other bin 0 never in the set)."""
         mt_f = meta.missing_type[feat]
         is_missing = (((mt_f == MISSING_NAN) & (fbins == meta.num_bin[feat] - 1))
                       | ((mt_f == MISSING_ZERO) & (fbins == meta.default_bin[feat])))
-        return jnp.where(is_missing, dleft, fbins <= thr)
+        num_left = jnp.where(is_missing, dleft, fbins <= thr)
+        if not sp.has_categorical:
+            return num_left
+        word = jnp.take(bitset, fbins // 32, mode="clip")
+        cat_left = ((word >> (fbins % 32)) & 1) > 0
+        return jnp.where(isc, cat_left, num_left)
 
     # ---- root (ref: serial_tree_learner BeforeTrain + root leaf splits) ----
     sum_g0 = jnp.sum(grad)
@@ -218,6 +234,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     root_best = best_of(root_hist, sum_g0, sum_h0, cnt0, jnp.asarray(0.0, f32))
 
     ni = max(L - 1, 1)
+    W = cat_bitset_words(B)
     tree = TreeArrays(
         num_leaves=jnp.asarray(1, jnp.int32),
         split_feature=jnp.zeros(ni, jnp.int32),
@@ -233,7 +250,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_weight=jnp.zeros(L, f32).at[0].set(sum_h0),
         leaf_count=jnp.zeros(L, jnp.int32).at[0].set(cnt0),
         leaf_parent=jnp.full(L, -1, jnp.int32),
-        leaf_depth=jnp.zeros(L, jnp.int32))
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        split_is_cat=jnp.zeros(ni, bool),
+        cat_bitset=jnp.zeros((ni, W), jnp.int32))
     pending = _PendingSplits(
         gain=jnp.full(L, K_MIN_SCORE, f32),
         feature=jnp.zeros(L, jnp.int32), threshold=jnp.zeros(L, jnp.int32),
@@ -241,7 +260,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         left_sum_gradient=jnp.zeros(L, f32), left_sum_hessian=jnp.zeros(L, f32),
         left_count=jnp.zeros(L, jnp.int32), left_output=jnp.zeros(L, f32),
         right_sum_gradient=jnp.zeros(L, f32), right_sum_hessian=jnp.zeros(L, f32),
-        right_count=jnp.zeros(L, jnp.int32), right_output=jnp.zeros(L, f32))
+        right_count=jnp.zeros(L, jnp.int32), right_output=jnp.zeros(L, f32),
+        is_cat=jnp.zeros(L, bool), cat_bitset=jnp.zeros((L, W), jnp.int32))
     pending = _pending_set(pending, 0, root_best)
 
     if params.use_hist_stack:
@@ -267,7 +287,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    leaf_seg_cnt=leaf_seg_cnt0,
                    done=jnp.asarray(False))
 
-    def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft):
+    def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
+                           isc, bitset):
         """Partitioned engine: read the split leaf's segment through a pow2
         bucket, partition it in place (stable: left rows first), recolor the
         right rows' leaf_id, and build the smaller child's histogram from
@@ -283,7 +304,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 valid = jnp.arange(S, dtype=jnp.int32) < seg_cnt
                 rows = jnp.take(binned_rows, idxs, axis=0)     # [S, F]
                 fbins = jnp.take(rows, feat, axis=1).astype(jnp.int32)
-                gl = go_left_of(fbins, feat, dleft, thr)
+                gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
                 lm = gl & valid
                 rm = (~gl) & valid
                 rmask = jnp.take(row_mask, idxs)
@@ -325,10 +346,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return (order, leaf_id, leaf_start, leaf_seg_cnt, small_hist,
                 cnt_l, cnt_r, smaller_is_left)
 
-    def mask_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft):
+    def mask_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
+                      isc, bitset):
         """Masked engine: recolor by scanning all rows (data-parallel safe)."""
         fbins = jnp.take(binned, feat, axis=0).astype(jnp.int32)
-        gl = go_left_of(fbins, feat, dleft, thr)
+        gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
         in_leaf = st.leaf_id == best_leaf
         leaf_id = jnp.where(in_leaf & ~gl, new_leaf, st.leaf_id)
         lmaskf = (in_leaf & gl).astype(f32) * row_mask
@@ -361,11 +383,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             feat = pd.feature[best_leaf]
             thr = pd.threshold[best_leaf]
             dleft = pd.default_left[best_leaf]
+            isc = pd.is_cat[best_leaf]
+            bitset = pd.cat_bitset[best_leaf]
 
             engine = partition_and_hist if use_partition else mask_and_hist
             (order, leaf_id, leaf_start, leaf_seg_cnt, small_hist,
              cnt_l, cnt_r, smaller_is_left) = engine(
-                st, best_leaf, new_leaf, feat, thr, dleft)
+                st, best_leaf, new_leaf, feat, thr, dleft, isc, bitset)
 
             # --- tree arrays (ref: tree.cpp Tree::Split) ---
             t = st.tree
@@ -398,6 +422,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                          .at[new_leaf].set(pd.right_sum_hessian[best_leaf]),
                 leaf_count=t.leaf_count.at[best_leaf].set(cnt_l)
                                        .at[new_leaf].set(cnt_r),
+                split_is_cat=t.split_is_cat.at[node].set(isc),
+                cat_bitset=t.cat_bitset.at[node].set(bitset),
                 leaf_parent=t.leaf_parent.at[best_leaf].set(node)
                                          .at[new_leaf].set(node),
                 leaf_depth=t.leaf_depth.at[best_leaf].set(depth)

@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import build_histogram_wave, wave_slot_pad
-from ..ops.split import K_MIN_SCORE, find_best_split
+from ..ops.split import K_MIN_SCORE, cat_bitset_words, find_best_split
 from .grow import FeatureMeta, GrowParams, TreeArrays
 
 
@@ -97,13 +97,15 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     best_vm = jax.vmap(
         lambda h, sg, sh, c, po: find_best_split(
             h, meta.num_bin, meta.missing_type, meta.default_bin,
-            meta.penalty, col_mask, sg, sh, c, po, sp))
+            meta.penalty, col_mask, sg, sh, c, po, sp,
+            is_cat_feature=meta.is_cat))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
     cnt0 = jnp.sum(row_mask).astype(i32)
 
     ni = max(L - 1, 1)
+    W = cat_bitset_words(B)
     # leaf-indexed arrays are sized to the padded slot bound (>= L) so
     # static [:NLp] slices stay in range; sliced back to [L] on return
     Lp = wave_slot_pad(L)
@@ -122,7 +124,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_weight=jnp.zeros(Lp, f32).at[0].set(sum_h0),
         leaf_count=jnp.zeros(Lp, i32).at[0].set(cnt0),
         leaf_parent=jnp.full(Lp, -1, i32),
-        leaf_depth=jnp.zeros(Lp, i32))
+        leaf_depth=jnp.zeros(Lp, i32),
+        split_is_cat=jnp.zeros(ni, bool),
+        cat_bitset=jnp.zeros((ni, W), i32))
 
     # per-leaf running sums / outputs for the gain scan
     leaf_sum_g0 = jnp.zeros(Lp, f32).at[0].set(sum_g0)
@@ -188,6 +192,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         internal_weight = nset(t.internal_weight,
                                best.left_sum_hessian + best.right_sum_hessian)
         internal_count = nset(t.internal_count, counts)  # exact
+        split_is_cat = nset(t.split_is_cat, best.is_cat)
+        cat_bitset = t.cat_bitset.at[drop].set(best.cat_bitset, mode="drop")
 
         # leaf records: old slot becomes the left child, new slot the right
         ldrop = jnp.where(split_sel, jnp.arange(NLp, dtype=i32), Lp)
@@ -219,16 +225,25 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             internal_count=internal_count,
             leaf_value=leaf_value, leaf_weight=leaf_weight,
             leaf_count=leaf_count, leaf_parent=leaf_parent,
-            leaf_depth=leaf_depth)
+            leaf_depth=leaf_depth,
+            split_is_cat=split_is_cat, cat_bitset=cat_bitset)
 
-        # 4. recolor rows: one packed [NLp, 8] table row-gather per row
-        packed = jnp.stack(
-            [split_sel.astype(i32), best.feature, best.threshold,
-             best.default_left.astype(i32), newleaf_of,
-             jnp.take(meta.missing_type, best.feature),
-             jnp.take(meta.default_bin, best.feature),
-             jnp.take(meta.num_bin, best.feature)], axis=1)  # [NLp, 8]
-        prow = jnp.take(packed, leaf_id, axis=0)             # [n, 8]
+        # 4. recolor rows: one packed table row-gather per row.  The table
+        # is [NLp, 8] numerical-only; the categorical columns (is_cat +
+        # bitset words) are appended only when the dataset has categorical
+        # features, keeping the hot gather narrow in the common case.
+        cols = [split_sel.astype(i32), best.feature, best.threshold,
+                best.default_left.astype(i32), newleaf_of,
+                jnp.take(meta.missing_type, best.feature),
+                jnp.take(meta.default_bin, best.feature),
+                jnp.take(meta.num_bin, best.feature)]
+        if sp.has_categorical:
+            packed = jnp.concatenate(
+                [jnp.stack(cols + [best.is_cat.astype(i32)], axis=1),
+                 best.cat_bitset], axis=1)                   # [NLp, 9+W]
+        else:
+            packed = jnp.stack(cols, axis=1)                 # [NLp, 8]
+        prow = jnp.take(packed, leaf_id, axis=0)
         sel_r = prow[:, 0] > 0
         feat_r = prow[:, 1]
         thr_r = prow[:, 2]
@@ -244,6 +259,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         is_missing = (((mt_r == MISSING_NAN) & (fbin == nb_r - 1))
                       | ((mt_r == MISSING_ZERO) & (fbin == db_r)))
         go_left = jnp.where(is_missing, dleft_r, fbin <= thr_r)
+        if sp.has_categorical:
+            isc_r = prow[:, 8] > 0
+            word_r = jnp.take_along_axis(
+                prow[:, 9:], jnp.clip(fbin // 32, 0, W - 1)[:, None],
+                1)[:, 0]
+            cat_left = ((word_r >> (fbin % 32)) & 1) > 0
+            go_left = jnp.where(isc_r, cat_left, go_left)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
 
         cont = (n_split > 0) & (tree.num_leaves < L)

@@ -100,20 +100,26 @@ class Tree:
                           missing_type: int) -> int:
         """Categorical split: left iff category in bitset (ref: tree.h SplitCategorical)."""
         new_node = self.num_leaves - 1
-        self.decision_type[new_node] = K_CATEGORICAL_MASK | ((missing_type & 3) << 2)
         self._split_common(new_node, leaf, inner_feature, real_feature,
                            left_value, right_value, left_cnt, right_cnt,
                            left_weight, right_weight, gain)
-        self.threshold_in_bin[new_node] = self.num_cat
-        self.threshold[new_node] = self.num_cat
-        bitset = _to_bitset(cats_in_left)
-        bitset_inner = _to_bitset(bins_in_left)
-        self.cat_threshold.extend(bitset)
+        self.register_cat_split(new_node, bins_in_left, cats_in_left,
+                                missing_type)
+        return new_node
+
+    def register_cat_split(self, node: int, bins_in_left: List[int],
+                           cats_in_left: List[int], missing_type: int) -> None:
+        """Record `node`'s category set: threshold = cat index, bitsets
+        appended, boundaries extended (ref: tree.h SplitCategorical
+        cat_boundaries_/cat_threshold_ bookkeeping)."""
+        self.decision_type[node] = K_CATEGORICAL_MASK | ((missing_type & 3) << 2)
+        self.threshold_in_bin[node] = self.num_cat
+        self.threshold[node] = self.num_cat
+        self.cat_threshold.extend(_to_bitset(cats_in_left))
         self.cat_boundaries.append(len(self.cat_threshold))
-        self.cat_threshold_inner.extend(bitset_inner)
+        self.cat_threshold_inner.extend(_to_bitset(bins_in_left))
         self.cat_boundaries_inner.append(len(self.cat_threshold_inner))
         self.num_cat += 1
-        return new_node
 
     def _split_common(self, new_node: int, leaf: int, inner_feature: int,
                       real_feature: int, left_value: float, right_value: float,

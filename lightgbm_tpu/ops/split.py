@@ -54,6 +54,24 @@ class SplitParams(NamedTuple):
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
     path_smooth: float = 0.0
+    # categorical split finding (ref: feature_histogram.cpp:144
+    # FindBestThresholdCategoricalInner); has_categorical=False skips the
+    # whole categorical branch at trace time
+    has_categorical: bool = False
+    # static inner-feature indices of the categorical features: the scan
+    # (argsort + two sequential prefix scans) runs only over these rows,
+    # not all F features; () falls back to scanning every feature
+    cat_features: tuple = ()
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: int = 100
+
+
+def cat_bitset_words(max_bin: int) -> int:
+    """int32 bitset words needed for a categorical split over max_bin bins."""
+    return max(1, (max_bin + 31) // 32)
 
 
 class SplitResult(NamedTuple):
@@ -70,6 +88,8 @@ class SplitResult(NamedTuple):
     right_sum_hessian: jnp.ndarray
     right_count: jnp.ndarray     # int32
     right_output: jnp.ndarray
+    is_cat: jnp.ndarray          # bool: categorical split (bitset routing)
+    cat_bitset: jnp.ndarray      # [W] int32 words: bins going LEFT
 
 
 def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
@@ -103,13 +123,135 @@ def _round_int(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.floor(x + 0.5).astype(jnp.int32)
 
 
+def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
+                    parent_output, min_gain_shift, p: SplitParams):
+    """Per-feature best CATEGORICAL split (ref: feature_histogram.cpp:144
+    FindBestThresholdCategoricalInner), vectorized over features.
+
+    Bin 0 is the NaN/other bin and never enters a left set (the reference
+    scans actual bins [1, num_bin); unseen/NaN categories route right).
+
+    Returns per-feature (gain [F], left_g, left_h, left_c, use_onehot,
+    onehot_bin, dir_is_fwd, prefix_len, used_bin, sorted_bins [F, B]).
+    """
+    F, B = grad.shape
+    f32 = jnp.float32
+    i32 = jnp.int32
+    bins = jnp.arange(B, dtype=i32)[None, :]
+    # cat_l2-augmented params for the sorted-subset branch only
+    pcat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+
+    in_range = (bins >= 1) & (bins < num_bin[:, None])
+    grad = jnp.where(in_range, grad, 0.0)
+    hess = jnp.where(in_range, hess, 0.0)
+    cnt = jnp.where(in_range, _round_int(hess * cnt_factor), 0)
+
+    def split_gain(lg, lh, lc, rg, rh, rc, ok, pp):
+        ok = (ok
+              & (lc >= p.min_data_in_leaf)
+              & (lh >= p.min_sum_hessian_in_leaf)
+              & (rc >= p.min_data_in_leaf)
+              & (rh >= p.min_sum_hessian_in_leaf))
+        gain = (leaf_gain(lg, lh, lc.astype(f32), parent_output, pp)
+                + leaf_gain(rg, rh, rc.astype(f32), parent_output, pp))
+        return jnp.where(ok & (gain > min_gain_shift), gain, K_MIN_SCORE)
+
+    # ---- one-hot mode: left = single category (hpp use_onehot branch) ----
+    # cat_l2 does NOT apply here: the reference adds it to l2 only in the
+    # sorted-subset else-branch (feature_histogram.cpp:250)
+    oh_gain = split_gain(grad, hess + K_EPSILON, cnt,
+                         sum_g - grad, sum_h - hess - K_EPSILON,
+                         num_data - cnt, in_range, p)
+    oh_best = jnp.argmax(oh_gain, axis=1).astype(i32)
+    take1 = lambda a, idx: jnp.take_along_axis(a, idx[:, None], 1)[:, 0]
+    oh_best_gain = take1(oh_gain, oh_best)
+
+    # ---- sorted-subset mode ----
+    # categories with enough data, stably sorted by grad/(hess+cat_smooth)
+    valid = in_range & (cnt >= p.cat_smooth)
+    ratio = jnp.where(valid, grad / (hess + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True).astype(i32)  # [F, B]
+    sg_s = jnp.take_along_axis(grad, order, 1)
+    sh_s = jnp.take_along_axis(hess, order, 1)
+    sc_s = jnp.take_along_axis(cnt, order, 1)
+    used_bin = jnp.sum(valid.astype(i32), axis=1)                # [F]
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin + 1) // 2)
+    steps = min(p.max_cat_threshold, B)
+
+    def scan_dir(fwd: bool):
+        if fwd:
+            g_d, h_d, c_d = sg_s, sh_s, sc_s
+        else:  # from the largest-ratio end over the VALID entries
+            pos = used_bin[:, None] - 1 - jnp.arange(B, dtype=i32)[None, :]
+            idx = jnp.clip(pos, 0, B - 1)
+            g_d = jnp.take_along_axis(sg_s, idx, 1)
+            h_d = jnp.take_along_axis(sh_s, idx, 1)
+            c_d = jnp.take_along_axis(sc_s, idx, 1)
+
+        def step(carry, i):
+            cum, lg, lh, lc = carry
+            lg = lg + g_d[:, i]
+            lh = lh + h_d[:, i]
+            lc = lc + c_d[:, i]
+            cum = cum + c_d[:, i]
+            rc = num_data - lc
+            rh = sum_h - lh
+            rg = sum_g - lg
+            # the reference's break conditions (right side shrinking) are
+            # monotone in i, so masking == breaking; the group counter
+            # resets whenever a candidate reaches evaluation, even if its
+            # gain then fails min_gain_shift (cpp:296-318 order)
+            left_ok = ((lc >= p.min_data_in_leaf)
+                       & (lh >= p.min_sum_hessian_in_leaf))
+            right_ok = ((rc >= p.min_data_in_leaf)
+                        & (rc >= p.min_data_per_group)
+                        & (rh >= p.min_sum_hessian_in_leaf))
+            in_limit = i < jnp.minimum(used_bin, max_num_cat)
+            eligible = (in_limit & left_ok & right_ok
+                        & (cum >= p.min_data_per_group))
+            raw = (leaf_gain(lg, lh, lc.astype(f32), parent_output, pcat)
+                   + leaf_gain(rg, rh, rc.astype(f32), parent_output, pcat))
+            gain = jnp.where(eligible & (raw > min_gain_shift), raw,
+                             K_MIN_SCORE)
+            cum = jnp.where(eligible, 0, cum)
+            return (cum, lg, lh, lc), (gain, lg, lh, lc)
+
+        init = (jnp.zeros(F, i32), jnp.zeros(F, f32),
+                jnp.full(F, K_EPSILON, f32), jnp.zeros(F, i32))
+        _, (gains, lgs, lhs, lcs) = jax.lax.scan(
+            step, init, jnp.arange(steps, dtype=i32))
+        # [steps, F] -> best prefix per feature
+        best_i = jnp.argmax(gains, axis=0).astype(i32)
+        takeS = lambda a: jnp.take_along_axis(a, best_i[None, :], 0)[0]
+        return takeS(gains), takeS(lgs), takeS(lhs), takeS(lcs), best_i
+
+    fw_gain, fw_lg, fw_lh, fw_lc, fw_i = scan_dir(True)
+    bw_gain, bw_lg, bw_lh, bw_lc, bw_i = scan_dir(False)
+    use_fw = fw_gain >= bw_gain
+    so_gain = jnp.where(use_fw, fw_gain, bw_gain)
+    so_lg = jnp.where(use_fw, fw_lg, bw_lg)
+    so_lh = jnp.where(use_fw, fw_lh, bw_lh)
+    so_lc = jnp.where(use_fw, fw_lc, bw_lc)
+    so_i = jnp.where(use_fw, fw_i, bw_i)
+
+    # per-feature mode choice is static in num_bin (hpp use_onehot)
+    use_onehot = num_bin <= p.max_cat_to_onehot
+    gain = jnp.where(use_onehot, oh_best_gain, so_gain)
+    left_g = jnp.where(use_onehot, take1(grad, oh_best), so_lg)
+    left_h = jnp.where(use_onehot, take1(hess, oh_best) + K_EPSILON, so_lh)
+    left_c = jnp.where(use_onehot, take1(cnt, oh_best), so_lc)
+    return (gain, left_g, left_h, left_c, use_onehot, oh_best, use_fw,
+            so_i, used_bin, order)
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     missing_type: jnp.ndarray, default_bin: jnp.ndarray,
                     feature_penalty: jnp.ndarray, col_mask: jnp.ndarray,
                     sum_gradient: jnp.ndarray, sum_hessian: jnp.ndarray,
                     num_data: jnp.ndarray, parent_output: jnp.ndarray,
-                    params: SplitParams) -> SplitResult:
+                    params: SplitParams,
+                    is_cat_feature: jnp.ndarray = None) -> SplitResult:
     """Scan all (feature, threshold, direction) candidates; return the leaf's best.
 
     Args:
@@ -201,6 +343,36 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     lh_raw = jnp.where(use_fwd, take(ph, fwd_best_idx),
                        take(rev_left_h_raw, rev_best_idx))
     lc = jnp.where(use_fwd, take(pc, fwd_best_idx), take(rev_left_c, rev_best_idx))
+    default_left_f = ~use_fwd
+
+    W = cat_bitset_words(max_bin)
+    if params.has_categorical:
+        # the expensive scan (argsort + two sequential prefix scans) runs
+        # only over the categorical rows, gathered into a static
+        # F_cat-sized subarray; results scatter back into the [F] arrays
+        is_cat_f = is_cat_feature
+        cat_idx = (params.cat_features if params.cat_features
+                   else tuple(range(num_features)))
+        ci = jnp.asarray(cat_idx, jnp.int32)
+        (cgain, clg, clh, clc, c_onehot, c_ohbin, c_fwd, c_plen, c_ub,
+         c_order) = _cat_best_split(
+            hist[ci, :, 0], hist[ci, :, 1], cnt_factor,
+            num_bin[ci], sum_g, sum_h, num_data, parent_output,
+            min_gain_shift, params)
+        # categorical features replace their numerical scan results;
+        # double-guard with is_cat_f (a numerical feature listed in
+        # cat_features must keep its numerical result)
+        catset = jnp.zeros(num_features, bool).at[ci].set(True) & is_cat_f
+        best_gain_f = jnp.where(catset, best_gain_f.at[ci].set(cgain),
+                                best_gain_f)
+        lg = jnp.where(catset, lg.at[ci].set(clg), lg)
+        lh_raw = jnp.where(catset, lh_raw.at[ci].set(clh - K_EPSILON),
+                           lh_raw)
+        lc = jnp.where(catset, lc.at[ci].set(clc), lc)
+        default_left_f = jnp.where(catset, False, default_left_f)
+        # map a winning full-F index back to its compact cat row
+        pos_of_f = jnp.zeros(num_features, jnp.int32).at[ci].set(
+            jnp.arange(len(cat_idx), dtype=jnp.int32))
 
     # feature penalty + column sampling, then pick the best feature
     # (gain tie -> smaller index, matching SplitInfo::operator>)
@@ -213,12 +385,55 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     lh_ = lh_raw[best_f] + K_EPSILON
     rg_, rc_ = sum_g - lg_, num_data - lc_
     rh_ = sum_h - lh_
-    left_out = leaf_output(lg_, lh_, lc_.astype(f32), parent_output, params)
-    right_out = leaf_output(rg_, rh_, rc_.astype(f32), parent_output, params)
+
+    if params.has_categorical:
+        won_cat = catset[best_f]
+        cpos = pos_of_f[best_f]          # winner's compact cat row
+        # leaf outputs use lambda_l2 + cat_l2 only for sorted-subset
+        # categorical winners, not one-hot (feature_histogram.cpp:250)
+        pcat = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+        won_subset = won_cat & ~c_onehot[cpos]
+        left_out = jnp.where(
+            won_subset,
+            leaf_output(lg_, lh_, lc_.astype(f32), parent_output, pcat),
+            leaf_output(lg_, lh_, lc_.astype(f32), parent_output, params))
+        right_out = jnp.where(
+            won_subset,
+            leaf_output(rg_, rh_, rc_.astype(f32), parent_output, pcat),
+            leaf_output(rg_, rh_, rc_.astype(f32), parent_output, params))
+        # winning left-category set as a bin bitset (ref: split_info.hpp
+        # cat_threshold; bins, not raw category values, on device)
+        bins_b = jnp.arange(max_bin, dtype=jnp.int32)
+        sorted_w = c_order[cpos]                         # [B] sorted bins
+        ub = c_ub[cpos]
+        plen = c_plen[cpos] + 1
+        pos = jnp.arange(max_bin, dtype=jnp.int32)
+        in_set_sorted = jnp.where(
+            c_fwd[cpos], pos < plen, (pos >= ub - plen) & (pos < ub))
+        member = jnp.zeros(max_bin, bool).at[sorted_w].set(
+            in_set_sorted, mode="drop")
+        member = jnp.where(c_onehot[cpos],
+                           bins_b == c_ohbin[cpos], member)
+        member = member & won_cat
+        word_idx = bins_b // 32
+        bit = (member.astype(jnp.int32) << (bins_b % 32))
+        cat_bitset = jnp.zeros(W, jnp.int32).at[word_idx].add(bit)
+        is_cat_out = won_cat
+        thr_out = jnp.where(won_cat, 0, best_thr_f[best_f])
+    else:
+        left_out = leaf_output(lg_, lh_, lc_.astype(f32), parent_output,
+                               params)
+        right_out = leaf_output(rg_, rh_, rc_.astype(f32), parent_output,
+                                params)
+        cat_bitset = jnp.zeros(W, jnp.int32)
+        is_cat_out = jnp.asarray(False)
+        thr_out = best_thr_f[best_f]
+
     return SplitResult(
-        gain=g_, feature=best_f, threshold=best_thr_f[best_f],
-        default_left=~use_fwd[best_f],
+        gain=g_, feature=best_f, threshold=thr_out,
+        default_left=default_left_f[best_f],
         left_sum_gradient=lg_, left_sum_hessian=lh_ - K_EPSILON,
         left_count=lc_, left_output=left_out,
         right_sum_gradient=rg_, right_sum_hessian=rh_ - K_EPSILON,
-        right_count=rc_, right_output=right_out)
+        right_count=rc_, right_output=right_out,
+        is_cat=is_cat_out, cat_bitset=cat_bitset)

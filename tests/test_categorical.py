@@ -1,0 +1,165 @@
+"""Categorical split finding (ref: feature_histogram.cpp:144
+FindBestThresholdCategoricalInner; tree.h:372 CategoricalDecision)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.learner import FeatureMeta, GrowParams, grow_tree
+from lightgbm_tpu.ops.split import (MISSING_NONE, SplitParams,
+                                    find_best_split)
+
+RNG = np.random.RandomState(7)
+
+
+def _cat_problem(n=4000, k=12, noise=0.1, seed=7):
+    """Label depends on membership of a category SUBSET whose ids are
+    shuffled, so an ordered numerical split cannot separate it."""
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, k, size=n)
+    good = set(rng.permutation(k)[:k // 2])
+    y = (np.isin(cat, list(good)).astype(np.float32)
+         + noise * rng.randn(n).astype(np.float32))
+    X = np.stack([cat.astype(np.float64),
+                  rng.rand(n)], axis=1)
+    return X, y, good
+
+
+def test_find_best_split_picks_category_subset():
+    """With a pure subset-separable gradient, the categorical scan must
+    recover (a superset of) the good-category set in its bitset."""
+    n, k = 4000, 12
+    X, y, good = _cat_problem(n, k, noise=0.0)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    core = ds._core_or_construct()
+    binned = core.binned
+    F = core.num_features
+    mappers = [core.bin_mappers[f] for f in core.used_features]
+    B = max(m.num_bin for m in mappers)
+    grad = -y.astype(np.float32)
+    hess = np.ones(n, np.float32)
+    # full-scan histogram
+    hist = np.zeros((F, B, 2), np.float32)
+    for f in range(F):
+        np.add.at(hist[f, :, 0], binned[f], grad)
+        np.add.at(hist[f, :, 1], binned[f], hess)
+    params = SplitParams(min_data_in_leaf=5, has_categorical=True,
+                         max_cat_to_onehot=4, min_data_per_group=10,
+                         cat_smooth=10.0, cat_l2=1.0)
+    meta_nb = jnp.asarray([m.num_bin for m in mappers], jnp.int32)
+    res = find_best_split(
+        jnp.asarray(hist), meta_nb,
+        jnp.asarray([m.missing_type for m in mappers], jnp.int32),
+        jnp.asarray([m.default_bin for m in mappers], jnp.int32),
+        jnp.ones(F, jnp.float32), jnp.ones(F, bool),
+        jnp.asarray(grad.sum()), jnp.asarray(hess.sum()),
+        jnp.asarray(n, jnp.int32), jnp.asarray(0.0), params,
+        is_cat_feature=jnp.asarray([m.bin_type == 1 for m in mappers]))
+    assert bool(res.is_cat)
+    assert int(res.feature) == 0
+    # decode bitset -> bins -> category values
+    words = np.asarray(res.cat_bitset)
+    bins_left = [b for b in range(mappers[0].num_bin)
+                 if (words[b // 32] >> (b % 32)) & 1]
+    cats_left = {mappers[0].bin_2_categorical[b] for b in bins_left}
+    # grad of good categories is negative (y=1) -> they sort first -> left
+    assert cats_left == good, (cats_left, good)
+
+
+def test_categorical_e2e_beats_numerical_treatment():
+    X, y, _ = _cat_problem(noise=0.05)
+    params = {"objective": "regression", "num_leaves": 4, "verbosity": -1,
+              "min_data_in_leaf": 5, "learning_rate": 0.5,
+              "min_data_per_group": 5, "max_cat_to_onehot": 1}
+    b_cat = lgb.train(params, lgb.Dataset(X, label=y,
+                                          categorical_feature=[0]),
+                      num_boost_round=6)
+    b_num = lgb.train(params, lgb.Dataset(X, label=y),
+                      num_boost_round=6)
+    mse_cat = float(np.mean((b_cat.predict(X) - y) ** 2))
+    mse_num = float(np.mean((b_num.predict(X) - y) ** 2))
+    # the subset is one categorical split but needs many numerical ones
+    assert mse_cat < mse_num, (mse_cat, mse_num)
+
+
+def test_categorical_model_roundtrip(tmp_path):
+    X, y, _ = _cat_problem(noise=0.05)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "min_data_per_group": 5}
+    booster = lgb.train(params, lgb.Dataset(X, label=y,
+                                            categorical_feature=[0]),
+                        num_boost_round=5)
+    pred = booster.predict(X)
+    path = str(tmp_path / "cat_model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), pred, rtol=1e-6)
+    # model text must carry the categorical block
+    text = open(path).read()
+    assert "cat_boundaries" in text or booster._gbdt.models_[0].num_cat > 0
+
+
+def test_onehot_split_excludes_cat_l2():
+    """One-hot categorical gain/output use lambda_l2 only; cat_l2 applies
+    solely to the sorted-subset branch (feature_histogram.cpp:250 puts
+    'l2 += cat_l2' in the else of use_onehot)."""
+    n, k = 300, 3
+    rng = np.random.RandomState(3)
+    cat = rng.randint(0, k, size=n)
+    grad = np.where(cat == 1, -1.0, 0.5).astype(np.float32)
+    grad += 0.01 * rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    X = cat.astype(np.float64)[:, None]
+    ds = lgb.Dataset(X, label=grad, categorical_feature=[0])
+    core = ds._core_or_construct()
+    mapper = core.bin_mappers[0]
+    B = mapper.num_bin
+    hist = np.zeros((1, B, 2), np.float32)
+    np.add.at(hist[0, :, 0], core.binned[0], grad)
+    np.add.at(hist[0, :, 1], core.binned[0], hess)
+    lambda_l2, cat_l2 = 0.5, 10.0
+
+    def run(cl2):
+        params = SplitParams(min_data_in_leaf=1, has_categorical=True,
+                             max_cat_to_onehot=k + 1, lambda_l2=lambda_l2,
+                             cat_l2=cl2, cat_smooth=0.0,
+                             min_data_per_group=1)
+        return find_best_split(
+            jnp.asarray(hist), jnp.asarray([B], jnp.int32),
+            jnp.asarray([mapper.missing_type], jnp.int32),
+            jnp.asarray([mapper.default_bin], jnp.int32),
+            jnp.ones(1, jnp.float32), jnp.ones(1, bool),
+            jnp.asarray(grad.sum()), jnp.asarray(hess.sum()),
+            jnp.asarray(n, jnp.int32), jnp.asarray(0.0), params,
+            is_cat_feature=jnp.asarray([True]))
+
+    res = run(cat_l2)
+    res0 = run(0.0)
+    assert bool(res.is_cat) and bool(res0.is_cat)
+    # cat_l2 must not alter a one-hot split's gain or leaf outputs
+    np.testing.assert_allclose(float(res.gain), float(res0.gain), rtol=1e-6)
+    np.testing.assert_allclose(float(res.left_output),
+                               float(res0.left_output), rtol=1e-6)
+    # and both must equal the closed form with lambda_l2 only
+    lg = float(res.left_sum_gradient)
+    lh = float(res.left_sum_hessian)
+    np.testing.assert_allclose(float(res.left_output),
+                               -lg / (lh + lambda_l2), rtol=1e-5)
+
+
+def test_categorical_onehot_mode():
+    """num_bin <= max_cat_to_onehot selects single-category splits."""
+    n, k = 2000, 3
+    rng = np.random.RandomState(9)
+    cat = rng.randint(0, k, size=n)
+    y = (cat == 1).astype(np.float32)
+    X = cat.astype(np.float64)[:, None]
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5, "max_cat_to_onehot": 8,
+              "min_data_per_group": 5, "learning_rate": 0.5}
+    booster = lgb.train(params, lgb.Dataset(X, label=y,
+                                            categorical_feature=[0]),
+                        num_boost_round=8)
+    pred = booster.predict(X)
+    # perfect separation achievable with one-hot splits
+    assert float(np.mean((pred - y) ** 2)) < 0.05
