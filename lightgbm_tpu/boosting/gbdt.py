@@ -122,6 +122,64 @@ class GBDT:
         self._pending = []       # device trees awaiting host materialization
         self._stump_idxs = set()  # model indices of no-split trees
 
+    # ------------------------------------------------------------ distributed
+    def _make_training_mesh(self, config: Config):
+        """Distributed learner selection (ref: tree_learner.cpp:15
+        CreateTreeLearner; SURVEY §2.3).  tree_learner=data shards the row
+        axis over a 1-D device mesh: the histogram reduction becomes a GSPMD
+        psum, replacing Network::ReduceScatter
+        (data_parallel_tree_learner.cpp:284), and the best-split argmax runs
+        on the replicated histogram, replacing SyncUpGlobalBestSplit.
+        tree_learner=feature shards the FEATURE axis of the binned matrix
+        (feature_parallel_tree_learner.cpp:23): each device scans its feature
+        block and the argmax all-gathers the winner.  voting maps to data —
+        PV-Tree's top-k vote exists to cut slow-ethernet histogram traffic,
+        which ICI makes unnecessary (SURVEY §2.3.4)."""
+        tl = config.tree_learner
+        if tl not in ("serial", "data", "feature", "voting"):
+            log.fatal(f"Unknown tree_learner {tl!r}")
+        if tl == "serial":
+            return None
+        ndev = len(jax.devices())
+        want = config.num_machines if config.num_machines > 1 else ndev
+        n_mesh = min(want, ndev)
+        if tl == "feature":
+            # GSPMD needs the sharded axis size divisible by the mesh: use
+            # the largest divisor of F (the reference instead hand-balances
+            # unequal feature subsets, feature_parallel_tree_learner.cpp:30)
+            F = len(self.train_data.used_features)
+            while n_mesh > 1 and F % n_mesh != 0:
+                n_mesh -= 1
+        if n_mesh <= 1:
+            return None
+        if tl == "voting":
+            log.warning("tree_learner=voting maps to the data-parallel mesh "
+                        "on TPU (ICI bandwidth makes the PV-Tree vote "
+                        "unnecessary)")
+            tl = "data"
+        from ..parallel import make_mesh
+        self._mesh_axis = 1 if tl in ("data", "voting") else 0
+        return make_mesh(n_mesh)
+
+    def _put_by_row(self, arr, axis=None, is_binned=False):
+        """Place a host array on the mesh, sharded along its row axis (the
+        LAST axis unless given); no-op single-device put without a mesh.
+        Under feature-parallel only the binned [F, n] matrix is sharded
+        (axis 0); all row tensors stay replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        a = np.asarray(arr)
+        if self._mesh_axis == 0:
+            if not is_binned:
+                return jnp.asarray(a)
+            spec = P("data", None)
+        else:
+            ax = a.ndim - 1 if axis is None else axis
+            spec = P(*(["data" if i == ax else None
+                        for i in range(a.ndim)]))
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
     # ------------------------------------------------------------------ init
     def init(self, config: Config, train_data: Dataset,
              objective: Optional[ObjectiveFunction],
@@ -145,9 +203,11 @@ class GBDT:
         self.n_pad = (n + _PAD - 1) // _PAD * _PAD
         binned = train_data.binned
         dtype = np.uint8 if train_data.max_num_bin <= 256 else np.int32
-        self.binned_dev = jnp.asarray(
-            _pad_rows(binned.astype(dtype), self.n_pad))
-        self.pad_mask = jnp.asarray(
+        self.mesh = self._make_training_mesh(config)
+        self.binned_dev = self._put_by_row(
+            _pad_rows(binned.astype(dtype), self.n_pad), axis=1,
+            is_binned=True)
+        self.pad_mask = self._put_by_row(
             _pad_rows(np.ones(n, np.float32), self.n_pad))
 
         # per-feature metadata, device side
@@ -204,6 +264,13 @@ class GBDT:
             # (ref: gpu_tree_learner.h:79 single-precision default).
             hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
                          if jax.default_backend() == "tpu" else "segment"))
+        if self.mesh is not None and self._mesh_axis == 1:
+            # row sharding: masked engine (global-index row gathers would
+            # all-gather the binned matrix) + XLA histogram (GSPMD cannot
+            # partition a pallas_call without shard_map)
+            from ..parallel import grow_params_for_mesh
+            self.grow_params = grow_params_for_mesh(
+                self.grow_params)._replace(hist_method="segment")
         # growth engine: wave (level-batched; one MXU histogram sweep per
         # round with leaf slots as the matmul's output columns) vs strict
         # leaf-wise (partitioned segments; the reference-parity order)
@@ -232,7 +299,8 @@ class GBDT:
 
         # scores [K, n_pad] on device
         K = self.num_tree_per_iteration
-        self.scores = jnp.zeros((K, self.n_pad), jnp.float32)
+        self.scores = self._put_by_row(
+            np.zeros((K, self.n_pad), np.float32), axis=1)
         md = train_data.metadata
         self.has_init_score = md.init_score is not None
         if self.has_init_score:
@@ -241,16 +309,18 @@ class GBDT:
                 init = np.tile(init, (K, 1)) if K > 1 else init[None, :]
             else:
                 init = init.reshape(K, n)
-            self.scores = jnp.asarray(
-                _pad_rows(init.astype(np.float32), self.n_pad))
+            self.scores = self._put_by_row(
+                _pad_rows(init.astype(np.float32), self.n_pad), axis=1)
 
         if objective is not None:
             objective.init(md, n)
             # objective.label may be transformed (e.g. reg_sqrt) — use it
-            self.label_dev = jnp.asarray(
+            self.label_dev = self._put_by_row(
                 _pad_rows(np.asarray(objective.label, np.float32), self.n_pad))
-            self.weight_dev = (None if md.weight is None else jnp.asarray(
-                _pad_rows(np.asarray(md.weight, np.float32), self.n_pad)))
+            self.weight_dev = (None if md.weight is None
+                               else self._put_by_row(_pad_rows(
+                                   np.asarray(md.weight, np.float32),
+                                   self.n_pad)))
             if getattr(objective, "need_train", True) is False:
                 self.class_need_train = [False] * K
             if not getattr(objective, "run_on_host", False):
@@ -325,7 +395,7 @@ class GBDT:
         self._ones_col_mask = jnp.ones(len(nb), bool)
         self._bag_mask_host = np.ones(self.n_pad, np.float32)
         self._bag_mask_host[n:] = 0.0
-        self.bag_mask = jnp.asarray(self._bag_mask_host)
+        self.bag_mask = self._put_by_row(self._bag_mask_host)
 
     def _raw_or_reconstruct(self, ds: Dataset) -> np.ndarray:
         """Raw feature matrix for prediction: the kept raw data when present,
