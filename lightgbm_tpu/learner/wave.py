@@ -54,15 +54,19 @@ from .grow import FeatureMeta, GrowParams, TreeArrays
 
 def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
     """XLA fallback (CPU tests): per-slot masked histograms via one-hot
-    einsum.  Small shapes only."""
+    einsum.  Small shapes only.  gh's LAST column is the count mask;
+    returns (hist [NL, F, B, C], counts [NL]) like the Pallas kernel."""
     oh_slot = (slot[:, None] == jnp.arange(num_slots)[None, :])  # [n, NL]
     oh_bin = (binned_fm[:, :, None] ==
               jnp.arange(max_bin, dtype=jnp.int32)[None, None, :])  # [F,n,B]
     # [NL, F, B, C]; histograms are exact accumulators, so force fp32
     # contraction (the TPU default would round operands to bf16)
-    return jnp.einsum("nl,fnb,nc->lfbc", oh_slot.astype(jnp.float32),
-                      oh_bin.astype(jnp.float32), gh,
+    hist = jnp.einsum("nl,fnb,nc->lfbc", oh_slot.astype(jnp.float32),
+                      oh_bin.astype(jnp.float32), gh[:, :-1],
                       precision=jax.lax.Precision.HIGHEST)
+    counts = jnp.einsum("nl,n->l", oh_slot.astype(jnp.float32), gh[:, -1],
+                        precision=jax.lax.Precision.HIGHEST)
+    return hist, counts
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -82,7 +86,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     row_mask = row_mask.astype(f32)
     grad = grad.astype(f32) * row_mask
     hess = hess.astype(f32) * row_mask
-    # channel 2 accumulates the row mask -> exact per-leaf counts
+    # 2 histogram channels; the trailing column is the count mask consumed
+    # by the kernel's fused per-slot count output (output lanes are the MXU
+    # cost driver — see _wave_kernel)
     gh = jnp.stack([grad, hess, row_mask], axis=1)
 
     use_pallas = params.hist_method == "pallas"
@@ -138,11 +144,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, _) = state
         NL = tree.num_leaves
 
-        # 1. all leaves' histograms in one pass; channel 2 = exact counts
-        hists = hists_of(leaf_id, NLp)                # [NLp, F, B, 3]
-        counts = jnp.round(jnp.sum(hists[:, 0, :, 2], axis=1)).astype(i32)
+        # 1. all leaves' histograms + exact per-slot counts in one pass
+        #    (DataPartition cnt_leaf_data)
+        hists, fcounts = hists_of(leaf_id, NLp)       # [NLp, F, B, 2], [NLp]
+        counts = jnp.round(fcounts).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
-        best = best_vm(hists[..., :2], leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+        best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
                        counts, leaf_out[:NLp])        # SplitResult over [NLp]
 
         # 2. select splitting leaves: positive gain, active, depth ok,

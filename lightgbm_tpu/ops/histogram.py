@@ -148,36 +148,64 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
     """Multi-leaf fused histogram kernel for wave (level-batched) growth.
 
     Per (slot-group, bin-group, feature-group, row-tile) grid cell, build
-    the [Fg, Bg, Rt] bin one-hot and the slot-separated channel matrices
-    [Rt, NLg] in VMEM, then one MXU dot per channel accumulates all NLg
-    leaves' histograms at once.  The leaf-slot axis is what fills the MXU's
-    128-wide output dimension — a plain per-leaf histogram dot has C=2..3
-    output columns and idles 125/128 of the systolic array, which is the
-    dominant cost of histogram construction on TPU.  (TPU replacement for
-    the CUDA per-leaf shared-memory kernels,
+    the [Fg, Bg, Rt] bin one-hot and the slot-separated channel matrix
+    [Rt, C*NLg] in VMEM, then ONE MXU dot accumulates all NLg leaves' and
+    all C channels' histograms at once.  The leaf-slot axis is what fills
+    the MXU's 128-wide output dimension — a plain per-leaf histogram dot
+    has C=2 output columns and idles 126/128 of the systolic array, which
+    is the dominant cost of histogram construction on TPU.  Fusing the
+    channels into the output dimension (instead of one dot per channel)
+    matters for the same reason: the MXU pads output lanes to 128, so
+    early waves with few slots pay for 128 lanes regardless — C dots at
+    NLg<=64 slots cost C times one fused dot.  (TPU replacement for the
+    CUDA per-leaf shared-memory kernels,
     ref: cuda_histogram_constructor.cu:18.)"""
-    def kernel(rows_ref, slot_ref, gh_ref, out_ref):
-        @pl.when(pl.program_id(3) == 0)
+    def kernel(rows_ref, slot_ref, gh_ref, out_ref, cnt_ref):
+        bg = pl.program_id(0)
+        g = pl.program_id(1)
+        @pl.when(pl.program_id(2) == 0)
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
-        s = pl.program_id(0)
-        bg = pl.program_id(1)
+        @pl.when((bg == 0) & (g == 0) & (pl.program_id(2) == 0))
+        def _init_cnt():
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
         rows = rows_ref[...].astype(jnp.int32)           # [Fg, Rt]
         slot = slot_ref[...].astype(jnp.int32)           # [Rt, 1]
-        gh = gh_ref[...]                                 # [Rt, C]
+        gh = gh_ref[...]                                 # [Rt, C+1]
         Rt = rows.shape[1]
-        loc = slot - s * NLg
-        soh = (loc == jax.lax.broadcasted_iota(jnp.int32, (Rt, NLg), 1))
         biota = (jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
                  + bg * Bg)
         oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
         oh2 = oh.reshape(Fg * Bg, Rt)
-        for c in range(C):
-            sc = soh.astype(jnp.bfloat16) * gh[:, c:c + 1].astype(jnp.bfloat16)
+        S = out_ref.shape[-1] // (C * NLg)
+        for s in range(S):  # slot groups REUSE the bin one-hot (its VPU
+            # construction, not the MXU dot, is the per-wave cost floor)
+            loc = slot - s * NLg
+            soh = (loc == jax.lax.broadcasted_iota(jnp.int32, (Rt, NLg), 1))
+            # [Rt, C*NLg] (c-major): channel value where the slot matches
+            # (built 2-D per channel — Mosaic cannot insert a bf16 minor dim)
+            sohb = soh.astype(jnp.bfloat16)
+            sc = jnp.concatenate(
+                [sohb * gh[:, c:c + 1].astype(jnp.bfloat16)
+                 for c in range(C)], axis=1)
             acc = jax.lax.dot_general(
                 oh2, sc, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [Fg*Bg, NLg]
-            out_ref[c] += acc.reshape(Fg, Bg, NLg)
+                preferred_element_type=jnp.float32)      # [Fg*Bg, C*NLg]
+            # lane dim stays flat (Mosaic cannot split the lane dim); the
+            # caller unscrambles the (slot-group, channel, slot) layout
+            w = C * NLg
+            out_ref[:, :, s * w:(s + 1) * w] += acc.reshape(Fg, Bg, w)
+            # exact per-slot row counts ride along as a [8, NLg] dot of the
+            # mask column (gh[:, C]) against the slot one-hot — one cell
+            # only, replacing a separate 20ms scatter-add pass
+            @pl.when((bg == 0) & (g == 0))
+            def _count():
+                mask8 = jnp.broadcast_to(
+                    gh[:, C:C + 1].astype(jnp.bfloat16), (Rt, 8)).T
+                cacc = jax.lax.dot_general(
+                    mask8, sohb, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [8, NLg]
+                cnt_ref[:, s * NLg:(s + 1) * NLg] += cacc
     return kernel
 
 
@@ -212,26 +240,31 @@ def wave_pallas_vmem_ok(num_features: int, max_bin: int,
                    static_argnames=("max_bin", "num_slots", "row_tile"))
 def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
                          gh: jnp.ndarray, *, max_bin: int, num_slots: int,
-                         row_tile: int = 256) -> jnp.ndarray:
+                         row_tile: int = 512):
     """Histograms for all leaf slots in one fused pass over the rows.
 
-    Grid = (slot groups, bin groups, feature groups, row tiles); each cell
-    is one MXU dot whose output columns are leaf slots, so the pass costs
-    the same MXU cycles as ONE plain histogram per 128 slots — the N-dim
-    filling trick that makes level-batched growth pay ~n*F*B cycles per
-    wave instead of per split.
+    Grid = (bin groups, feature groups, row tiles); each cell builds the
+    bin one-hot ONCE and loops the slot groups inside, one MXU dot per
+    slot group whose output columns are (channel, slot) pairs.  The leaf-
+    slot axis fills the MXU's 128-wide output dimension — a plain per-leaf
+    histogram dot has C=2 output columns and idles most of the systolic
+    array.  The one-hot's VPU construction is the cost floor, so its
+    volume (F*B*n per wave) is built exactly once regardless of slot
+    count.  Exact per-slot row counts ride along as a second output — the
+    mask column against the slot one-hot.  (TPU replacement for the CUDA
+    per-leaf shared-memory kernels, cuda_histogram_constructor.cu:18.)
 
     Args:
       binned_fm: [F, n] feature-major bin codes.
-      slot: [n] int32 leaf slot per row (rows that must not contribute
-        carry zeroed gh channels).
-      gh: [n, C] per-row accumulands (gradient, hessian, count-mask, ...).
+      slot: [n] int32 leaf slot per row.
+      gh: [n, C+1] per-row accumulands (gradient, hessian, ..., row-mask);
+        the LAST column is the count mask (zeros for excluded rows).
       max_bin: B (static).  num_slots: NL leaf slots (static).
 
-    Returns: [NL, F, B, C] float32.
+    Returns: (hist [NL, F, B, C] float32, counts [NL] float32).
     """
     F, n = binned_fm.shape
-    C = gh.shape[-1]
+    C = gh.shape[-1] - 1
     NLp = wave_slot_pad(num_slots)
     NLg = min(NLp, 128)
     Bp = max(8, (max_bin + 7) // 8 * 8)
@@ -245,21 +278,30 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     Fp = (F + 7) // 8 * 8
     if Fp != F:
         binned_fm = jnp.pad(binned_fm, ((0, Fp - F), (0, 0)))
-    # feature group bounded by the VMEM accumulator [C, Fg, Bg, NLg]
-    Fg = _pick_feature_group(Fp, C * Bg * NLg * 4, 4 << 20)
-    out = pl.pallas_call(
+    S = NLp // NLg
+    # feature group bounded by the VMEM accumulator [Fg, Bg, S*C*NLg] plus
+    # the [Fg, Bg, Rt] bf16 one-hot
+    Fg = _pick_feature_group(
+        Fp, Bg * (S * C * NLg * 4 + row_tile * 2), 6 << 20)
+    out, cnt = pl.pallas_call(
         _wave_kernel(C, Fg, Bg, NLg),
-        grid=(NLp // NLg, Bp // Bg, Fp // Fg, n // row_tile),
+        grid=(Bp // Bg, Fp // Fg, n // row_tile),
         in_specs=[
-            pl.BlockSpec((Fg, row_tile), lambda s, bg, g, i: (g, i)),
-            pl.BlockSpec((row_tile, 1), lambda s, bg, g, i: (i, 0)),
-            pl.BlockSpec((row_tile, C), lambda s, bg, g, i: (i, 0))],
-        out_specs=pl.BlockSpec((C, Fg, Bg, NLg),
-                               lambda s, bg, g, i: (0, g, bg, s)),
-        out_shape=jax.ShapeDtypeStruct((C, Fp, Bp, NLp), jnp.float32),
+            pl.BlockSpec((Fg, row_tile), lambda bg, g, i: (g, i)),
+            pl.BlockSpec((row_tile, 1), lambda bg, g, i: (i, 0)),
+            pl.BlockSpec((row_tile, C + 1), lambda bg, g, i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((Fg, Bg, S * C * NLg),
+                         lambda bg, g, i: (g, bg, 0)),
+            pl.BlockSpec((8, NLp), lambda bg, g, i: (0, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((Fp, Bp, S * C * NLg), jnp.float32),
+            jax.ShapeDtypeStruct((8, NLp), jnp.float32)],
     )(binned_fm, slot.reshape(n, 1), gh)
-    # [C, Fp, Bp, NLp] -> [NL, F, B, C]
-    return out.transpose(3, 1, 2, 0)[:num_slots, :F, :max_bin, :]
+    # [Fp, Bp, (s, c, lg)] -> [NL, F, B, C]
+    out = out.reshape(Fp, Bp, S, C, NLg).transpose(2, 4, 0, 1, 3)
+    hist = out.reshape(S * NLg, Fp, Bp, C)[:num_slots, :F, :max_bin, :]
+    return hist, cnt[0, :num_slots]
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "method", "row_chunk"))
