@@ -390,6 +390,66 @@ class GBDT:
                              jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
             return scores.at[class_id].add(delta * pad_mask)
         self._score_update_shrink_fn = _score_update_shrink
+        # ---- quantized training (ref: gradient_discretizer.{hpp,cpp};
+        # config use_quantized_grad/num_grad_quant_bins/stochastic_rounding).
+        # Gradients/hessians are snapped to the reference's integer grid on
+        # device and DEQUANTIZED in place: the information content matches
+        # the reference's int8 path exactly (k * scale for k in
+        # [-qbins/2, qbins/2]), while accumulation stays in the fp32
+        # histogram kernels (small integers times one scale are exact in
+        # bf16 multiply / fp32 add).  The reference's 8/16/32-bit histogram
+        # bin-width selection (SetNumBitsInHistogramBin) is a CPU memory
+        # optimization with no TPU analogue.
+        self.use_quant = config.use_quantized_grad
+        if self.use_quant:
+            qhalf = max(config.num_grad_quant_bins // 2, 1)
+            qbins = config.num_grad_quant_bins
+            stoch = config.stochastic_rounding
+            const_hess = bool(objective is not None
+                              and getattr(objective, "is_constant_hessian",
+                                          False)
+                              and train_data.metadata.weight is None)
+            base_key = jax.random.PRNGKey(config.seed + 5)
+
+            def _disc(grad, hess, it):
+                # ref: gradient_discretizer.cpp:120-160 DiscretizeGradients
+                gscale = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-35) / qhalf
+                if const_hess:
+                    hscale = jnp.maximum(jnp.max(jnp.abs(hess)), 1e-35)
+                else:
+                    hscale = (jnp.maximum(jnp.max(jnp.abs(hess)), 1e-35)
+                              / qbins)
+                if stoch:
+                    kg, kh = jax.random.split(
+                        jax.random.fold_in(base_key, it))
+                    rg = jax.random.uniform(kg, grad.shape)
+                    rh = jax.random.uniform(kh, hess.shape)
+                else:
+                    rg = rh = 0.5
+                # static_cast<int8_t> truncates toward zero; the +/- noise
+                # by gradient sign makes it stochastic round away from zero
+                gi = jnp.trunc(grad / gscale + jnp.sign(grad) * rg)
+                hi = (jnp.ones_like(hess) if const_hess
+                      else jnp.trunc(hess / hscale + rh))
+                return gi * gscale, hi * hscale
+            self._discretize_fn = jax.jit(_disc)
+            if config.quant_train_renew_leaf:
+                renew_p = SplitParams(
+                    lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
+                    max_delta_step=config.max_delta_step)
+
+                def _renew(leaf_value, leaf_id, grad, hess, mask):
+                    # ref: gradient_discretizer.cpp RenewIntGradTreeOutput —
+                    # leaf outputs recomputed from the ORIGINAL float grads
+                    from ..ops.split import leaf_output
+                    L = leaf_value.shape[0]
+                    ids = jnp.clip(leaf_id, 0, L - 1)
+                    sg = jnp.zeros(L, jnp.float32).at[ids].add(grad * mask)
+                    sh = jnp.zeros(L, jnp.float32).at[ids].add(hess * mask)
+                    out = leaf_output(sg, sh, jnp.zeros(L), 0.0, renew_p)
+                    return jnp.where(sh > 0, out, leaf_value)
+                self._renew_quant_fn = jax.jit(_renew)
+
         self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._ones_col_mask = jnp.ones(len(nb), bool)
@@ -571,11 +631,21 @@ class GBDT:
         for k in range(K):
             tree = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
+                g_k = self._slice_row_fn(grad, k)
+                h_k = self._slice_row_fn(hess, k)
+                if self.use_quant:
+                    # per-tree discretization (ref: serial_tree_learner
+                    # BeforeTrain -> DiscretizeGradients on the class slice)
+                    gq, hq = self._discretize_fn(
+                        g_k, h_k, np.int32(self.iter_ * K + k))
+                else:
+                    gq, hq = g_k, h_k
                 arrays, leaf_id = self._grow_fn(
-                    self.binned_dev, self._slice_row_fn(grad, k),
-                    self._slice_row_fn(hess, k), bag_mask,
+                    self.binned_dev, gq, hq, bag_mask,
                     self._col_mask(), self.meta, self.grow_params)
-                tree = self._finalize_tree(arrays, leaf_id, k, init_scores[k])
+                tree = self._finalize_tree(arrays, leaf_id, k,
+                                           init_scores[k],
+                                           float_grads=(g_k, h_k))
             if tree is None:
                 if len(self.models_) < K:
                     tree = self._make_const_stump(k)
@@ -756,7 +826,7 @@ class GBDT:
         return tree
 
     def _finalize_tree(self, arrays, leaf_id, class_id: int,
-                       init_score: float):
+                       init_score: float, float_grads=None):
         """Renew/shrink/score-update after growing (ref: gbdt.cpp:395-407).
 
         Fast path: every host sync on a fresh device result costs ~100ms on
@@ -767,6 +837,14 @@ class GBDT:
         packed buffer has settled — the boosting loop never blocks on D2H.
         """
         obj = self.objective
+        if (self.use_quant and self.config.quant_train_renew_leaf
+                and float_grads is not None):
+            # quantized leaf renewal runs first, then any objective renewal
+            # (ref: serial tree learner renews int-grad outputs inside
+            # Train; GBDT::TrainOneIter renews for the objective after)
+            arrays = arrays._replace(leaf_value=self._renew_quant_fn(
+                arrays.leaf_value, leaf_id, float_grads[0], float_grads[1],
+                self.bag_mask))
         need_sync = ((obj is not None and obj.need_renew_tree_output)
                      or bool(self.valid_sets))
         if not need_sync:

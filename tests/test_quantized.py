@@ -1,0 +1,112 @@
+"""Quantized training (ref: src/treelearner/gradient_discretizer.{hpp,cpp};
+config.h:619-641 use_quantized_grad / num_grad_quant_bins /
+quant_train_renew_leaf / stochastic_rounding)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _binary_problem(n=4000, F=8, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F)
+    logit = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] - 0.5 * X[:, 3]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    return X, y
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(len(y))
+    pos = y > 0
+    np_, nn = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - np_ * (np_ - 1) / 2) / (np_ * nn)
+
+
+def test_quantized_quality_parity_binary():
+    """AUC with 4-bin quantized gradients stays within a small delta of the
+    fp32 path (the reference's whole premise, gradient_discretizer.hpp)."""
+    X, y = _binary_problem()
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "learning_rate": 0.1, "seed": 7}
+    rounds = 30
+    b_fp = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b_q = lgb.train({**base, "use_quantized_grad": True},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    auc_fp = _auc(y, b_fp.predict(X))
+    auc_q = _auc(y, b_q.predict(X))
+    assert auc_q > auc_fp - 0.01, (auc_q, auc_fp)
+
+
+def test_quantized_regression_with_renew():
+    """quant_train_renew_leaf recomputes leaf outputs from float grads —
+    required for regression quality (ref: RenewIntGradTreeOutput)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(3000, 6)
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.1 * rng.randn(3000)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "learning_rate": 0.1}
+    rounds = 30
+    b_fp = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b_q = lgb.train({**base, "use_quantized_grad": True,
+                     "quant_train_renew_leaf": True},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    mse_fp = float(np.mean((b_fp.predict(X) - y) ** 2))
+    mse_q = float(np.mean((b_q.predict(X) - y) ** 2))
+    assert mse_q < mse_fp * 1.3, (mse_q, mse_fp)
+
+
+def test_quantized_gradients_live_on_grid():
+    """Discretized gradients must be integer multiples of the scale with
+    |k| <= num_grad_quant_bins/2 (gradient_discretizer.cpp:120)."""
+    import jax.numpy as jnp
+    X, y = _binary_problem(n=1000)
+    booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1, "use_quantized_grad": True,
+                         "num_grad_quant_bins": 4},
+                        lgb.Dataset(X, label=y), num_boost_round=1)
+    g = booster._gbdt
+    grad, hess = g._grad_fn(g.scores)
+    gq, hq = g._discretize_fn(g._slice_row_fn(grad, 0),
+                              g._slice_row_fn(hess, 0), np.int32(0))
+    gq = np.asarray(gq)
+    grad0 = np.asarray(grad)[0]
+    gscale = np.abs(grad0).max() / 2
+    k = gq / gscale
+    np.testing.assert_allclose(k, np.round(k), atol=1e-4)
+    assert np.abs(k).max() <= 2 + 1e-6
+    hq = np.asarray(hq)
+    hscale = np.abs(np.asarray(hess)[0]).max() / 4
+    kh = hq / hscale
+    np.testing.assert_allclose(kh, np.round(kh), atol=1e-4)
+    assert kh.min() >= -1e-6
+
+
+def test_quantized_deterministic_rounding_mode():
+    """stochastic_rounding=False uses round-half-away deterministically:
+    identical runs give identical models."""
+    X, y = _binary_problem(n=1500)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "use_quantized_grad": True, "stochastic_rounding": False}
+    from lightgbm_tpu.boosting.model_io import save_model_to_string
+    b1 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert (save_model_to_string(b1._gbdt)
+            == save_model_to_string(b2._gbdt))
+
+
+def test_quantized_constant_hessian_is_exact_ones():
+    """Constant-hessian objectives keep hess == 1 (hscale = max|h|,
+    int hess = 1; gradient_discretizer.cpp:128)."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(1000, 4)
+    y = X[:, 0] + 0.1 * rng.randn(1000)
+    booster = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1, "use_quantized_grad": True},
+                        lgb.Dataset(X, label=y), num_boost_round=1)
+    g = booster._gbdt
+    grad, hess = g._grad_fn(g.scores)
+    _, hq = g._discretize_fn(g._slice_row_fn(grad, 0),
+                             g._slice_row_fn(hess, 0), np.int32(0))
+    np.testing.assert_allclose(np.asarray(hq), 1.0, rtol=1e-6)
