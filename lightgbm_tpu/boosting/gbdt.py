@@ -227,12 +227,32 @@ class GBDT:
             for i, f in enumerate(train_data.used_features):
                 if f < len(config.feature_contri):
                     penalty[i] = config.feature_contri[f]
+        # monotone constraints indexed by real feature -> used features
+        # (ref: config.h monotone_constraints; monotone_constraints.hpp)
+        mono = np.zeros(len(nb), np.int32)
+        if config.monotone_constraints:
+            mc_list = list(config.monotone_constraints)
+            for i, f in enumerate(train_data.used_features):
+                if f < len(mc_list):
+                    mono[i] = int(mc_list[f])
+        self.f_monotone = mono
+        has_mono = bool(np.any(mono != 0))
+        if has_mono and config.monotone_constraints_method not in (
+                "basic", "intermediate", "advanced"):
+            log.fatal("Unknown monotone_constraints_method "
+                      f"{config.monotone_constraints_method!r}")
+        if has_mono and config.monotone_constraints_method != "basic":
+            log.warning(f"monotone_constraints_method="
+                        f"{config.monotone_constraints_method} falls back "
+                        "to basic on TPU (slack propagation across leaves "
+                        "is inherently sequential)")
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(self.f_num_bin),
             missing_type=jnp.asarray(self.f_missing_type),
             default_bin=jnp.asarray(self.f_default_bin),
             penalty=jnp.asarray(penalty),
-            is_cat=jnp.asarray(self.f_is_cat))
+            is_cat=jnp.asarray(self.f_is_cat),
+            monotone=jnp.asarray(mono))
 
         max_b = int(self.f_num_bin.max()) if len(nb) else 1
         # histogram stack memory guard (HistogramPool analogue)
@@ -255,7 +275,9 @@ class GBDT:
                 max_cat_to_onehot=config.max_cat_to_onehot,
                 max_cat_threshold=config.max_cat_threshold,
                 cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
-                min_data_per_group=config.min_data_per_group),
+                min_data_per_group=config.min_data_per_group,
+                has_monotone=has_mono,
+                monotone_penalty=config.monotone_penalty),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's

@@ -54,6 +54,7 @@ class FeatureMeta(NamedTuple):
     default_bin: jnp.ndarray    # [F] int32
     penalty: jnp.ndarray        # [F] float32 (feature_contri)
     is_cat: jnp.ndarray = None  # [F] bool (None when no categorical)
+    monotone: jnp.ndarray = None  # [F] int32 -1/0/+1 (None when unused)
 
 
 class GrowParams(NamedTuple):
@@ -122,6 +123,8 @@ class _State(NamedTuple):
     order: jnp.ndarray          # [n + S_max] row permutation (or [1] dummy)
     leaf_start: jnp.ndarray     # [L] segment starts (partitioned engine)
     leaf_seg_cnt: jnp.ndarray   # [L] segment lengths incl. bagged-out rows
+    leaf_cmin: jnp.ndarray      # [L] monotone min constraint (or [1] dummy)
+    leaf_cmax: jnp.ndarray      # [L] monotone max constraint
     done: jnp.ndarray           # scalar bool
 
 
@@ -188,11 +191,27 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return build_histogram(rows.T, gh_sub, member_mask, max_bin=B,
                                method=params.hist_method)
 
-    def best_of(hist, sum_g, sum_h, cnt, parent_out):
+    def mono_penalty_of(depth):
+        """ref: monotone_constraints.hpp:357 ComputeMonotoneSplitGainPenalty."""
+        pen = sp.monotone_penalty
+        d = depth.astype(f32)
+        eps = 1e-15
+        return jnp.where(pen >= d + 1.0, eps,
+                         jnp.where(pen <= 1.0,
+                                   1.0 - pen / jnp.exp2(d) + eps,
+                                   1.0 - jnp.exp2(pen - 1.0 - d) + eps))
+
+    def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
+                depth=None):
+        kw = {}
+        if sp.has_monotone:
+            kw = dict(monotone=meta.monotone, constraint_min=cmin,
+                      constraint_max=cmax,
+                      mono_penalty=mono_penalty_of(depth))
         return find_best_split(hist, meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
                                sum_g, sum_h, cnt, parent_out, sp,
-                               is_cat_feature=meta.is_cat)
+                               is_cat_feature=meta.is_cat, **kw)
 
     # pow2 bucket ladder for the partitioned engine; the last bucket covers
     # the whole row range (used by the root split)
@@ -231,7 +250,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sum_h0 = jnp.sum(hess)
     cnt0 = jnp.sum(row_mask.astype(jnp.int32))
     root_hist = hist_of(ones_mask)
-    root_best = best_of(root_hist, sum_g0, sum_h0, cnt0, jnp.asarray(0.0, f32))
+    inf = jnp.asarray(jnp.inf, f32)
+    root_best = best_of(root_hist, sum_g0, sum_h0, cnt0,
+                        jnp.asarray(0.0, f32), -inf, inf,
+                        jnp.asarray(0, jnp.int32))
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -285,6 +307,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    leaf_sum_h=jnp.zeros(L, f32).at[0].set(sum_h0),
                    order=order0, leaf_start=leaf_start0,
                    leaf_seg_cnt=leaf_seg_cnt0,
+                   leaf_cmin=jnp.full(L if sp.has_monotone else 1, -jnp.inf,
+                                      f32),
+                   leaf_cmax=jnp.full(L if sp.has_monotone else 1, jnp.inf,
+                                      f32),
                    done=jnp.asarray(False))
 
     def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
@@ -448,10 +474,36 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 hist_r = hist_of(rmaskf)
                 hist_stack = st.hist_stack
 
+            # --- monotone constraint propagation (basic mode, ref:
+            # monotone_constraints.hpp:489 BasicLeafConstraints::Update:
+            # the new leaf clones the parent entry, then a numerical split
+            # on a monotone feature bounds both children at the midpoint)
+            if sp.has_monotone:
+                p_min = st.leaf_cmin[best_leaf]
+                p_max = st.leaf_cmax[best_leaf]
+                mc_w = meta.monotone[feat]
+                mid = (pd.left_output[best_leaf]
+                       + pd.right_output[best_leaf]) / 2.0
+                apply = (mc_w != 0) & ~isc
+                pos = apply & (mc_w > 0)
+                neg = apply & (mc_w < 0)
+                l_max = jnp.where(pos, jnp.minimum(p_max, mid), p_max)
+                l_min = jnp.where(neg, jnp.maximum(p_min, mid), p_min)
+                r_min = jnp.where(pos, jnp.maximum(p_min, mid), p_min)
+                r_max = jnp.where(neg, jnp.minimum(p_max, mid), p_max)
+                leaf_cmin = (st.leaf_cmin.at[best_leaf].set(l_min)
+                             .at[new_leaf].set(r_min))
+                leaf_cmax = (st.leaf_cmax.at[best_leaf].set(l_max)
+                             .at[new_leaf].set(r_max))
+            else:
+                leaf_cmin, leaf_cmax = st.leaf_cmin, st.leaf_cmax
+                l_min = l_max = r_min = r_max = None
+
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
-                             pd.left_output[best_leaf])
+                             pd.left_output[best_leaf], l_min, l_max, depth)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
-                             pd.right_output[best_leaf])
+                             pd.right_output[best_leaf], r_min, r_max,
+                             depth)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
@@ -462,6 +514,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                                   .at[new_leaf].set(rsum_h),
                           order=order, leaf_start=leaf_start,
                           leaf_seg_cnt=leaf_seg_cnt,
+                          leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
                           done=st.done)
 
         return jax.lax.cond(proceed, do_split,

@@ -100,11 +100,30 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return _hist_wave_xla(binned, leaf_id, gh, max_bin=B,
                               num_slots=num_slots)
 
-    best_vm = jax.vmap(
-        lambda h, sg, sh, c, po: find_best_split(
-            h, meta.num_bin, meta.missing_type, meta.default_bin,
-            meta.penalty, col_mask, sg, sh, c, po, sp,
-            is_cat_feature=meta.is_cat))
+    if sp.has_monotone:
+        def _pen_of(depth):
+            """ref: monotone_constraints.hpp:357."""
+            pen, d = sp.monotone_penalty, depth.astype(f32)
+            return jnp.where(pen >= d + 1.0, 1e-15,
+                             jnp.where(pen <= 1.0,
+                                       1.0 - pen / jnp.exp2(d) + 1e-15,
+                                       1.0 - jnp.exp2(pen - 1.0 - d)
+                                       + 1e-15))
+
+        best_vm = jax.vmap(
+            lambda h, sg, sh, c, po, cmin, cmax, dep: find_best_split(
+                h, meta.num_bin, meta.missing_type, meta.default_bin,
+                meta.penalty, col_mask, sg, sh, c, po, sp,
+                is_cat_feature=meta.is_cat, monotone=meta.monotone,
+                constraint_min=cmin, constraint_max=cmax,
+                mono_penalty=_pen_of(dep)),
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+    else:
+        best_vm = jax.vmap(
+            lambda h, sg, sh, c, po: find_best_split(
+                h, meta.num_bin, meta.missing_type, meta.default_bin,
+                meta.penalty, col_mask, sg, sh, c, po, sp,
+                is_cat_feature=meta.is_cat))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
@@ -138,10 +157,14 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     leaf_sum_g0 = jnp.zeros(Lp, f32).at[0].set(sum_g0)
     leaf_sum_h0 = jnp.zeros(Lp, f32).at[0].set(sum_h0)
     leaf_out0 = jnp.zeros(Lp, f32)
+    cm_n = Lp if sp.has_monotone else 1
+    leaf_cmin0 = jnp.full(cm_n, -jnp.inf, f32)
+    leaf_cmax0 = jnp.full(cm_n, jnp.inf, f32)
 
     def wave_body(state, NLp):
         """One wave with a static slot bound NLp >= current num_leaves."""
-        (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, _) = state
+        (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
+         leaf_cmin, leaf_cmax, _) = state
         NL = tree.num_leaves
 
         # 1. all leaves' histograms + exact per-slot counts in one pass
@@ -149,8 +172,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hists, fcounts = hists_of(leaf_id, NLp)       # [NLp, F, B, 2], [NLp]
         counts = jnp.round(fcounts).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
-        best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                       counts, leaf_out[:NLp])        # SplitResult over [NLp]
+        if sp.has_monotone:
+            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                           counts, leaf_out[:NLp], leaf_cmin[:NLp],
+                           leaf_cmax[:NLp], tree.leaf_depth[:NLp])
+        else:
+            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                           counts, leaf_out[:NLp])    # SplitResult over [NLp]
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget
@@ -222,6 +250,22 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_sum_h = lset(leaf_sum_h, best.left_sum_hessian,
                           best.right_sum_hessian)
         leaf_out = lset(leaf_out, best.left_output, best.right_output)
+        if sp.has_monotone:
+            # basic-mode constraint propagation (BasicLeafConstraints::
+            # Update): children bounded at the output midpoint
+            p_min = leaf_cmin[:NLp]
+            p_max = leaf_cmax[:NLp]
+            mc_w = jnp.take(meta.monotone, best.feature)
+            mid = (best.left_output + best.right_output) / 2.0
+            apply = split_sel & (mc_w != 0) & ~best.is_cat
+            pos = apply & (mc_w > 0)
+            neg = apply & (mc_w < 0)
+            l_max = jnp.where(pos, jnp.minimum(p_max, mid), p_max)
+            l_min = jnp.where(neg, jnp.maximum(p_min, mid), p_min)
+            r_min = jnp.where(pos, jnp.maximum(p_min, mid), p_min)
+            r_max = jnp.where(neg, jnp.minimum(p_max, mid), p_max)
+            leaf_cmin = lset(leaf_cmin, l_min, r_min)
+            leaf_cmax = lset(leaf_cmax, l_max, r_max)
 
         tree = TreeArrays(
             num_leaves=NL + n_split,
@@ -276,14 +320,15 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
 
         cont = (n_split > 0) & (tree.num_leaves < L)
-        return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out, cont)
+        return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
+                leaf_cmin, leaf_cmax, cont)
 
     state = (tree, jnp.zeros(n, i32), leaf_sum_g0, leaf_sum_h0, leaf_out0,
-             jnp.asarray(L > 1))
+             leaf_cmin0, leaf_cmax0, jnp.asarray(L > 1))
     num_waves = max(1, math.ceil(math.log2(L))) if L > 1 else 0
     for k in range(num_waves):
         NLp = wave_slot_pad(min(1 << k, L))
-        state = jax.lax.cond(state[5],
+        state = jax.lax.cond(state[-1],
                              functools.partial(wave_body, NLp=NLp),
                              lambda s: s, state)
     if num_waves > 0:
@@ -291,7 +336,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # more rounds than the unrolled ladder: keep waving at the full
         # slot bound until no leaf splits or the budget is exhausted
         state = jax.lax.while_loop(
-            lambda s: s[5],
+            lambda s: s[-1],
             functools.partial(wave_body, NLp=wave_slot_pad(L)), state)
 
     tree, leaf_id = state[0], state[1]

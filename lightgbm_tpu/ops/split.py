@@ -63,6 +63,14 @@ class SplitParams(NamedTuple):
     # not all F features; () falls back to scanning every feature
     cat_features: tuple = ()
     max_cat_to_onehot: int = 4
+    # monotone constraints, basic mode (ref: monotone_constraints.hpp:465
+    # BasicLeafConstraints; feature_histogram.hpp:758 GetSplitGains USE_MC):
+    # candidate outputs are clamped to the leaf's [min, max] and splits
+    # violating the ordering are rejected.  False skips all of it at trace
+    # time.  monotone_penalty is the config value fed to
+    # ComputeMonotoneSplitGainPenalty (monotone_constraints.hpp:357).
+    has_monotone: bool = False
+    monotone_penalty: float = 0.0
     max_cat_threshold: int = 32
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
@@ -114,6 +122,11 @@ def leaf_gain(sum_g, sum_h, count, parent_output, p: SplitParams):
         sg_l1 = threshold_l1(sum_g, p.lambda_l1)
         return (sg_l1 * sg_l1) / (sum_h + p.lambda_l2)
     out = leaf_output(sum_g, sum_h, count, parent_output, p)
+    return leaf_gain_given_output(sum_g, sum_h, out, p)
+
+
+def leaf_gain_given_output(sum_g, sum_h, out, p: SplitParams):
+    """ref: feature_histogram.hpp:820 GetLeafGainGivenOutput."""
     sg_l1 = threshold_l1(sum_g, p.lambda_l1)
     return -(2.0 * sg_l1 * out + (sum_h + p.lambda_l2) * out * out)
 
@@ -251,7 +264,11 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     sum_gradient: jnp.ndarray, sum_hessian: jnp.ndarray,
                     num_data: jnp.ndarray, parent_output: jnp.ndarray,
                     params: SplitParams,
-                    is_cat_feature: jnp.ndarray = None) -> SplitResult:
+                    is_cat_feature: jnp.ndarray = None,
+                    monotone: jnp.ndarray = None,
+                    constraint_min: jnp.ndarray = None,
+                    constraint_max: jnp.ndarray = None,
+                    mono_penalty: jnp.ndarray = None) -> SplitResult:
     """Scan all (feature, threshold, direction) candidates; return the leaf's best.
 
     Args:
@@ -307,6 +324,26 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         gain = (leaf_gain(left_g, left_h, left_c.astype(f32), parent_output, params)
                 + leaf_gain(right_g, right_h, right_c.astype(f32), parent_output,
                             params))
+        if params.has_monotone:
+            # constrained gain for monotone features: outputs clamped to
+            # the leaf's [min, max]; ordering violations score 0
+            # (feature_histogram.hpp:758-797 GetSplitGains USE_MC branch)
+            mc = monotone[:, None]
+            lout = jnp.clip(leaf_output(left_g, left_h, left_c.astype(f32),
+                                        parent_output, params),
+                            constraint_min, constraint_max)
+            rout = jnp.clip(leaf_output(right_g, right_h,
+                                        right_c.astype(f32),
+                                        parent_output, params),
+                            constraint_min, constraint_max)
+            bad = (((mc > 0) & (lout > rout)) | ((mc < 0) & (lout < rout)))
+            # clamping applies to EVERY feature once the leaf is
+            # constrained (USE_MC templates the whole learner); the
+            # ordering rejection only to monotone features
+            gain_mc = (leaf_gain_given_output(left_g, left_h, lout, params)
+                       + leaf_gain_given_output(right_g, right_h, rout,
+                                                params))
+            gain = jnp.where(bad & (mc != 0), 0.0, gain_mc)
         ok = ok & (gain > min_gain_shift)
         return jnp.where(ok, gain, K_MIN_SCORE)
 
@@ -377,6 +414,10 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     # feature penalty + column sampling, then pick the best feature
     # (gain tie -> smaller index, matching SplitInfo::operator>)
     shifted = (best_gain_f - min_gain_shift) * feature_penalty
+    if params.has_monotone and params.monotone_penalty > 0:
+        # depth-based penalty on monotone features' gains
+        # (serial_tree_learner.cpp:987-991)
+        shifted = jnp.where(monotone != 0, shifted * mono_penalty, shifted)
     shifted = jnp.where(col_mask & (best_gain_f > K_MIN_SCORE), shifted, K_MIN_SCORE)
     best_f = jnp.argmax(shifted, axis=0).astype(jnp.int32)
 
@@ -428,6 +469,12 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         cat_bitset = jnp.zeros(W, jnp.int32)
         is_cat_out = jnp.asarray(False)
         thr_out = best_thr_f[best_f]
+
+    if params.has_monotone:
+        # the leaf's [min, max] clamps the winner's stored outputs too
+        # (CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:740)
+        left_out = jnp.clip(left_out, constraint_min, constraint_max)
+        right_out = jnp.clip(right_out, constraint_min, constraint_max)
 
     return SplitResult(
         gain=g_, feature=best_f, threshold=thr_out,

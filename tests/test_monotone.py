@@ -1,0 +1,93 @@
+"""Monotone constraints, basic mode (ref: monotone_constraints.hpp:465
+BasicLeafConstraints; feature_histogram.hpp:758 GetSplitGains USE_MC;
+serial_tree_learner.cpp:987 monotone_penalty)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _problem(n=4000, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3)
+    # y increases in X0, decreases in X1, noisy in X2
+    y = (2 * X[:, 0] - 1.5 * X[:, 1] + 0.3 * np.sin(8 * X[:, 2])
+         + 0.1 * rng.randn(n))
+    return X, y
+
+
+def _is_monotone(booster, feature, direction, others=(0.5, 0.5)):
+    grid = np.linspace(0.01, 0.99, 50)
+    X = np.full((50, 3), 0.5)
+    for j, v in zip([f for f in range(3) if f != feature], others):
+        X[:, j] = v
+    X[:, feature] = grid
+    pred = booster.predict(X)
+    diffs = np.diff(pred)
+    if direction > 0:
+        return bool((diffs >= -1e-10).all())
+    return bool((diffs <= 1e-10).all())
+
+
+@pytest.mark.parametrize("strategy", ["leafwise", "wave"])
+def test_predictions_respect_constraints(strategy):
+    X, y = _problem()
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "learning_rate": 0.2, "min_data_in_leaf": 5,
+              "monotone_constraints": [1, -1, 0],
+              "tpu_growth_strategy": strategy}
+    booster = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _is_monotone(booster, 0, +1)
+    assert _is_monotone(booster, 1, -1)
+    # sanity: the model still learns (not constant)
+    pred = booster.predict(X)
+    assert float(np.corrcoef(pred, y)[0, 1]) > 0.7
+
+
+def test_unconstrained_model_violates():
+    """The same noisy monotone problem WITHOUT constraints should produce at
+    least one local violation — otherwise the constrained test is vacuous."""
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = np.stack([rng.rand(n), rng.rand(n), rng.rand(n)], 1)
+    y = X[:, 0] + 0.8 * np.sin(12 * X[:, 0]) + 0.2 * rng.randn(n)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "learning_rate": 0.2, "min_data_in_leaf": 5}
+    b_free = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert not _is_monotone(b_free, 0, +1)
+    b_mc = lgb.train({**params, "monotone_constraints": [1, 0, 0]},
+                     lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _is_monotone(b_mc, 0, +1)
+
+
+def test_monotone_penalty_discourages_root_splits():
+    """monotone_penalty shrinks monotone features' gains near the root
+    (1 - p/2^depth; monotone_constraints.hpp:357): with a huge penalty the
+    root split must pick the unconstrained feature."""
+    rng = np.random.RandomState(2)
+    n = 3000
+    X = np.stack([rng.rand(n), rng.rand(n)], 1)
+    # feature 0 slightly stronger, but penalized
+    y = (1.2 * (X[:, 0] > 0.5) + 1.0 * (X[:, 1] > 0.5)
+         + 0.05 * rng.randn(n))
+    params = {"objective": "regression", "num_leaves": 4, "verbosity": -1,
+              "min_data_in_leaf": 5, "monotone_constraints": [1, 0]}
+    b0 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1)
+    b0._gbdt._sync_model()
+    t0 = b0._gbdt.models_[0]
+    b1 = lgb.train({**params, "monotone_penalty": 2.0},
+                   lgb.Dataset(X, label=y), num_boost_round=1)
+    b1._gbdt._sync_model()
+    t1 = b1._gbdt.models_[0]
+    assert t0.split_feature[0] == 0       # unpenalized: monotone feat wins
+    assert t1.split_feature[0] == 1       # penalized at depth 0 and 1
+
+
+def test_monotone_with_alias_param():
+    X, y = _problem(n=1000)
+    booster = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1, "mc": [1, 0, 0],
+                         "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+    assert _is_monotone(booster, 0, +1)
