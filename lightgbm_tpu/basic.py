@@ -279,12 +279,15 @@ class Booster:
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         if hasattr(data, "values"):
             data = data.values
-        if pred_contrib:
-            log.fatal("pred_contrib (SHAP) is not implemented yet")
         if num_iteration is None:
             num_iteration = -1
         if self.best_iteration > 0 and num_iteration == -1:
             num_iteration = self.best_iteration
+        if pred_contrib:
+            return self._gbdt.predict_contrib(
+                np.asarray(data, np.float64),
+                start_iteration=start_iteration,
+                num_iteration=num_iteration)
         return self._gbdt.predict(np.asarray(data, np.float64),
                                   raw_score=raw_score,
                                   start_iteration=start_iteration,

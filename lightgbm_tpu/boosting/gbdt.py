@@ -1024,6 +1024,34 @@ class GBDT:
             return np.asarray(self.objective.convert_output(jnp_.asarray(raw.T))).T
         return np.asarray(self.objective.convert_output(jnp_.asarray(raw)))
 
+    def predict_contrib(self, X: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions [n, K*(F+1)]: per class, F per-feature
+        columns plus the expected value, summing to the raw score
+        (ref: gbdt.h:314 PredictContrib; tree.h:139; TreeSHAP in
+        src/io/tree.cpp)."""
+        from ..native import tree_shap
+        self._sync_model()
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        F = self.train_data.num_total_features
+        total_iters = len(self.models_) // K
+        if num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        phi = np.zeros((K, n, F + 1))
+        for it in range(start_iteration, end):
+            for k in range(K):
+                tree_shap(self.models_[it * K + k], X, phi[k])
+        if self.average_output_ and end > start_iteration:
+            phi /= end - start_iteration
+        if K == 1:
+            return phi[0]
+        return phi.transpose(1, 0, 2).reshape(n, K * (F + 1))
+
     def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
                            num_iteration: int = -1) -> np.ndarray:
         self._sync_model()

@@ -1,0 +1,198 @@
+"""Native runtime pieces (C, ctypes-loaded, compiled on demand).
+
+The reference keeps its host-side runtime in C++ (TreeSHAP in
+src/io/tree.cpp, the predictor in src/application/predictor.hpp); the TPU
+framework's device path is XLA, but host-side recursive algorithms with no
+vectorizable structure stay native here too.  Compilation uses the
+toolchain's cc once per source hash, cached under ~/.cache/lightgbm_tpu.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_shap_lib = None
+_shap_tried = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    d = os.path.join(base, "lightgbm_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(src_path: str, tag: str) -> Optional[str]:
+    """Compile src to a cached shared library; returns its path or None."""
+    with open(src_path, "rb") as f:
+        src = f.read()
+    h = hashlib.sha256(src).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"lib{tag}-{h}.so")
+    if os.path.exists(out):
+        return out
+    for cc in ("cc", "gcc", "g++", "clang"):
+        try:
+            tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src_path, "-lm"],
+                capture_output=True, timeout=120)
+            if r.returncode == 0:
+                os.replace(tmp, out)
+                return out
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return None
+
+
+def treeshap_lib():
+    """The compiled TreeSHAP library, or None when no compiler works."""
+    global _shap_lib, _shap_tried
+    if _shap_tried:
+        return _shap_lib
+    _shap_tried = True
+    path = _compile(os.path.join(_SRC_DIR, "treeshap.c"), "treeshap")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    c_int_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    c_dbl_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    c_i8_p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+    c_u32_p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    lib.treeshap_batch.argtypes = [
+        c_int_p, c_dbl_p, c_i8_p, c_int_p, c_int_p,      # split/thr/dt/lc/rc
+        c_dbl_p, c_dbl_p, c_dbl_p,                       # leaf_value/ic/lc
+        c_u32_p, c_int_p, ctypes.c_int, ctypes.c_int,    # cat, num_cat, nl
+        c_dbl_p, ctypes.c_long, ctypes.c_int,            # X, rows, xcols
+        c_dbl_p, ctypes.c_int, c_dbl_p]                  # phi, ncol, scratch
+    lib.treeshap_batch.restype = ctypes.c_int
+    _shap_lib = lib
+    return lib
+
+
+def tree_shap(tree, X: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate one tree's SHAP values into phi [n, ncol] (last column =
+    expected value).  Native when a compiler is available, Python fallback
+    otherwise (ref: tree.h:139 PredictContrib)."""
+    n = X.shape[0]
+    ncol = phi.shape[1]
+    nl = tree.num_leaves
+    if nl <= 1:
+        phi[:, -1] += tree.leaf_value[0]
+        return
+    ni = nl - 1
+    depth = int(np.max(tree.leaf_depth[:nl])) if nl > 1 else 1
+    lib = treeshap_lib()
+    X = np.ascontiguousarray(X, np.float64)
+    if lib is not None:
+        scratch = np.zeros(((depth + 2) * (depth + 3) // 2) * 4, np.float64)
+        if tree.num_cat:
+            cat_thr = np.ascontiguousarray(tree.cat_threshold, np.uint32)
+            cat_b = np.ascontiguousarray(tree.cat_boundaries, np.int32)
+        else:
+            cat_thr = np.zeros(1, np.uint32)
+            cat_b = np.zeros(2, np.int32)
+        rc = lib.treeshap_batch(
+            np.ascontiguousarray(tree.split_feature[:ni], np.int32),
+            np.ascontiguousarray(tree.threshold[:ni], np.float64),
+            np.ascontiguousarray(tree.decision_type[:ni], np.int8),
+            np.ascontiguousarray(tree.left_child[:ni], np.int32),
+            np.ascontiguousarray(tree.right_child[:ni], np.int32),
+            np.ascontiguousarray(tree.leaf_value[:nl], np.float64),
+            np.ascontiguousarray(tree.internal_count[:ni], np.float64),
+            np.ascontiguousarray(tree.leaf_count[:nl], np.float64),
+            cat_thr, cat_b, int(tree.num_cat), int(nl),
+            X, n, X.shape[1], phi, ncol, scratch)
+        if rc == 0:
+            return
+    _tree_shap_py(tree, X, phi)
+
+
+# ---------------------------------------------------------------- fallback
+def _tree_shap_py(tree, X, phi):
+    """Pure-Python TreeSHAP (Lundberg et al. 2018, Algorithm 2) — slow;
+    used only when no C compiler is available."""
+    nl = tree.num_leaves
+    counts = {}
+
+    def node_count(nd):
+        return (tree.leaf_count[~nd] if nd < 0
+                else tree.internal_count[nd])
+
+    expected = float(np.dot(tree.leaf_value[:nl], tree.leaf_count[:nl])
+                     / max(tree.internal_count[0], 1))
+
+    def extend(path, zf, of, fi):
+        path = path + [[fi, zf, of, 1.0 if not path else 0.0]]
+        d = len(path) - 1
+        for i in range(d - 1, -1, -1):
+            path[i + 1][3] += of * path[i][3] * (i + 1) / (d + 1)
+            path[i][3] = zf * path[i][3] * (d - i) / (d + 1)
+        return path
+
+    def unwound_sum(path, pi):
+        d = len(path) - 1
+        of, zf = path[pi][2], path[pi][1]
+        nop = path[d][3]
+        total = 0.0
+        for i in range(d - 1, -1, -1):
+            if of != 0:
+                tmp = nop * (d + 1) / ((i + 1) * of)
+                total += tmp
+                nop = path[i][3] - tmp * zf * (d - i) / (d + 1)
+            else:
+                total += path[i][3] / (zf * (d - i) / (d + 1))
+        return total
+
+    def unwind(path, pi):
+        d = len(path) - 1
+        of, zf = path[pi][2], path[pi][1]
+        nop = path[d][3]
+        path = [list(e) for e in path]
+        for i in range(d - 1, -1, -1):
+            if of != 0:
+                tmp = path[i][3]
+                path[i][3] = nop * (d + 1) / ((i + 1) * of)
+                nop = tmp - path[i][3] * zf * (d - i) / (d + 1)
+            else:
+                path[i][3] = path[i][3] * (d + 1) / (zf * (d - i))
+        for i in range(pi, d):
+            path[i][:3] = path[i + 1][:3]
+        return path[:d]
+
+    def recurse(r, node, path, zf, of, fi, ph):
+        path = extend([list(e) for e in path], zf, of, fi)
+        if node < 0:
+            v = tree.leaf_value[~node]
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                ph[path[i][0]] += w * (path[i][2] - path[i][1]) * v
+            return
+        feat = tree.split_feature[node]
+        go_left = bool(tree._decision(
+            np.asarray([X[r, feat]]), np.asarray([node]))[0])
+        hot = tree.left_child[node] if go_left else tree.right_child[node]
+        cold = (tree.right_child[node] if go_left
+                else tree.left_child[node])
+        w = node_count(node)
+        hzf = node_count(hot) / w
+        czf = node_count(cold) / w
+        izf = iof = 1.0
+        pi = next((i for i, e in enumerate(path) if e[0] == feat), None)
+        if pi is not None:
+            izf, iof = path[pi][1], path[pi][2]
+            path = unwind(path, pi)
+        recurse(r, hot, path, hzf * izf, iof, feat, ph)
+        recurse(r, cold, path, czf * izf, 0.0, feat, ph)
+
+    for r in range(X.shape[0]):
+        recurse(r, 0, [], 1.0, 1.0, -1, phi[r])
+        phi[r, -1] += expected

@@ -1,0 +1,115 @@
+"""Reference-parity harness: train real LightGBM (CLI oracle built by
+tools/build_reference_oracle.sh) and lightgbm_tpu on identical data with
+identical params, then compare models and predictions
+(ref test pattern: tests/python_package_test/test_consistency.py:1-143).
+
+Skipped when the oracle binary is absent (env LIGHTGBM_ORACLE overrides
+the default /tmp/lgb_ref_src/lightgbm path).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+ORACLE = os.environ.get("LIGHTGBM_ORACLE", "/tmp/lgb_ref_src/lightgbm")
+DATA = "/root/reference/examples/binary_classification/binary.train"
+TEST = "/root/reference/examples/binary_classification/binary.test"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(ORACLE),
+    reason="reference oracle not built (run tools/build_reference_oracle.sh)")
+
+PARAMS = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+              num_iterations=10, min_data_in_leaf=20, max_bin=255,
+              deterministic=True, force_row_wise=True, verbosity=-1,
+              feature_fraction=1.0, bagging_fraction=1.0)
+
+
+def _run_oracle(tmp_path, extra=""):
+    conf = tmp_path / "train.conf"
+    model = tmp_path / "model.txt"
+    conf.write_text(
+        f"task = train\ndata = {DATA}\noutput_model = {model}\n"
+        + "".join(f"{k} = {v}\n" for k, v in PARAMS.items()) + extra)
+    r = subprocess.run([ORACLE, f"config={conf}"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    pred_conf = tmp_path / "pred.conf"
+    pred_out = tmp_path / "pred.txt"
+    pred_conf.write_text(
+        f"task = predict\ndata = {TEST}\ninput_model = {model}\n"
+        f"output_result = {pred_out}\n")
+    r = subprocess.run([ORACLE, f"config={pred_conf}"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return model, np.loadtxt(pred_out)
+
+
+@pytest.fixture(scope="module")
+def oracle_run(tmp_path_factory):
+    return _run_oracle(tmp_path_factory.mktemp("oracle"))
+
+
+def test_loads_real_reference_model_and_matches_predictions(oracle_run):
+    """Our Booster must parse a model file written by REAL LightGBM and
+    reproduce its predictions (model-format interop, both directions of
+    the v4 text format)."""
+    model, ref_pred = oracle_run
+    booster = lgb.Booster(model_file=str(model))
+    X = np.loadtxt(TEST)[:, 1:]
+    ours = booster.predict(X)
+    np.testing.assert_allclose(ours, ref_pred, rtol=1e-5, atol=1e-7)
+
+
+def test_training_parity_same_data_same_params(oracle_run):
+    """Training on the same file with the same params must produce a model
+    of near-identical quality and highly correlated predictions.  (Exact
+    tree equality needs bit-identical histogram accumulation; quality
+    parity is what test_consistency.py-style runs assert.)"""
+    _, ref_pred = oracle_run
+    train = np.loadtxt(DATA)
+    test = np.loadtxt(TEST)
+    params = dict(PARAMS)
+    params.pop("num_iterations")
+    booster = lgb.train(params, lgb.Dataset(train[:, 1:],
+                                            label=train[:, 0]),
+                        num_boost_round=10)
+    ours = booster.predict(test[:, 1:])
+
+    def auc(y, s):
+        order = np.argsort(s)
+        ranks = np.empty(len(y))
+        ranks[order] = np.arange(len(y))
+        pos = y > 0
+        return ((ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2)
+                / (pos.sum() * (~pos).sum()))
+
+    y = test[:, 0]
+    auc_ref = auc(y, ref_pred)
+    auc_ours = auc(y, ours)
+    assert abs(auc_ref - auc_ours) < 0.01, (auc_ref, auc_ours)
+    corr = np.corrcoef(ref_pred, ours)[0, 1]
+    assert corr > 0.97, corr
+
+
+def test_first_tree_root_split_matches(oracle_run):
+    """With identical GreedyFindBin binning, the first tree's root split
+    (feature, threshold) must match the reference exactly."""
+    model, _ = oracle_run
+    ref_booster = lgb.Booster(model_file=str(model))
+    ref_tree = ref_booster._gbdt.models_[0]
+
+    train = np.loadtxt(DATA)
+    params = dict(PARAMS)
+    params.pop("num_iterations")
+    ours = lgb.train(params, lgb.Dataset(train[:, 1:], label=train[:, 0]),
+                     num_boost_round=1)
+    ours._gbdt._sync_model()
+    our_tree = ours._gbdt.models_[0]
+    assert our_tree.split_feature[0] == ref_tree.split_feature[0]
+    np.testing.assert_allclose(our_tree.threshold[0], ref_tree.threshold[0],
+                               rtol=1e-10)

@@ -1,0 +1,115 @@
+"""SHAP / pred_contrib (ref: tree.h:139 PredictContrib; TreeSHAP in
+src/io/tree.cpp; python predict(pred_contrib=True))."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _problem(n=2000, F=5, seed=8):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F)
+    y = X[:, 0] * 2 + X[:, 1] * X[:, 2] + 0.1 * rng.randn(n)
+    return X, y
+
+
+def test_contrib_sums_to_raw_prediction():
+    """Additivity: sum of contributions + expected value == raw score."""
+    X, y = _problem()
+    booster = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "verbosity": -1, "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    sub = X[:100]
+    contrib = booster.predict(sub, pred_contrib=True)
+    assert contrib.shape == (100, X.shape[1] + 1)
+    raw = booster.predict(sub, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_contrib_binary_sums_to_raw():
+    X, y = _problem()
+    yb = (y > 0).astype(np.float64)
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, lgb.Dataset(X, label=yb),
+                        num_boost_round=8)
+    contrib = booster.predict(X[:50], pred_contrib=True)
+    raw = booster.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_contrib_multiclass_shape_and_sum():
+    rng = np.random.RandomState(1)
+    X = rng.randn(900, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int) + (X[:, 2] > 1)
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+    contrib = booster.predict(X[:40], pred_contrib=True)
+    assert contrib.shape == (40, 3 * (4 + 1))
+    raw = booster.predict(X[:40], raw_score=True)
+    per_class = contrib.reshape(40, 3, 5).sum(axis=2)
+    np.testing.assert_allclose(per_class, raw, rtol=1e-5, atol=1e-7)
+
+
+def test_contrib_matches_brute_force_shapley():
+    """On a tiny 2-feature tree, TreeSHAP must equal the exact Shapley
+    values computed by brute-force path enumeration."""
+    rng = np.random.RandomState(2)
+    n = 800
+    X = rng.rand(n, 2)
+    y = 1.0 * (X[:, 0] > 0.5) + 2.0 * (X[:, 1] > 0.5)
+    booster = lgb.train({"objective": "regression", "num_leaves": 4,
+                         "verbosity": -1, "min_data_in_leaf": 5,
+                         "learning_rate": 1.0, "boost_from_average": False},
+                        lgb.Dataset(X, label=y), num_boost_round=1)
+    booster._gbdt._sync_model()
+    tree = booster._gbdt.models_[0]
+
+    def cond_exp(x, S):
+        """E[f(X) | X_S = x_S] under the tree's path-dependent weighting."""
+        def rec(node, w):
+            if node < 0:
+                return w * tree.leaf_value[~node]
+            f = tree.split_feature[node]
+            lc, rc = tree.left_child[node], tree.right_child[node]
+            if f in S:
+                go_left = x[f] <= tree.threshold[node]
+                return rec(lc if go_left else rc, w)
+            cl = (tree.leaf_count[~lc] if lc < 0
+                  else tree.internal_count[lc])
+            cr = (tree.leaf_count[~rc] if rc < 0
+                  else tree.internal_count[rc])
+            tot = cl + cr
+            return rec(lc, w * cl / tot) + rec(rc, w * cr / tot)
+        return rec(0, 1.0)
+
+    xs = X[:5]
+    contrib = booster.predict(xs, pred_contrib=True)
+    import math
+    F = 2
+    for r, x in enumerate(xs):
+        for j in range(F):
+            phi = 0.0
+            others = [f for f in range(F) if f != j]
+            for k in range(len(others) + 1):
+                for S in itertools.combinations(others, k):
+                    wgt = (math.factorial(len(S))
+                           * math.factorial(F - len(S) - 1)
+                           / math.factorial(F))
+                    phi += wgt * (cond_exp(x, set(S) | {j})
+                                  - cond_exp(x, set(S)))
+            np.testing.assert_allclose(contrib[r, j], phi, rtol=1e-6,
+                                       atol=1e-9)
+        np.testing.assert_allclose(contrib[r, -1], cond_exp(x, set()),
+                                   rtol=1e-6)
+
+
+def test_native_lib_compiles():
+    from lightgbm_tpu.native import treeshap_lib
+    assert treeshap_lib() is not None, \
+        "native TreeSHAP failed to compile (cc available in the image)"

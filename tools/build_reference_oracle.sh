@@ -1,0 +1,72 @@
+#!/bin/bash
+# Build the reference LightGBM CLI as a parity-test oracle in /tmp.
+#
+# The reference tree at /root/reference is read-only and its vendored
+# submodules (fmt, fast_double_parser, eigen) are empty, so this script
+# clones it to /tmp, installs two tiny stub headers (strtod / snprintf
+# shims), drops the Eigen-dependent linear tree learner, and builds the
+# CPU CLI.  tests/test_reference_parity.py skips unless the binary exists.
+set -euo pipefail
+
+SRC=${1:-/root/reference}
+WORK=/tmp/lgb_ref_src
+BUILD=/tmp/lgb_ref_build
+
+[ -x "$WORK/lightgbm" ] && { echo "oracle already built: $WORK/lightgbm"; exit 0; }
+
+rm -rf "$WORK" "$BUILD"
+cp -r "$SRC" "$WORK"
+sed -i 's/cmake_minimum_required(VERSION 3.28)/cmake_minimum_required(VERSION 3.18)/' "$WORK/CMakeLists.txt"
+sed -i 's|      src/treelearner/linear_tree_learner.cpp||' "$WORK/CMakeLists.txt"
+sed -i 's|#include "linear_tree_learner.h"||' "$WORK/src/treelearner/tree_learner.cpp"
+sed -i 's|        return new LinearTreeLearner(config);|        Log::Fatal("linear tree disabled in oracle build");|' "$WORK/src/treelearner/tree_learner.cpp"
+
+mkdir -p "$WORK/external_libs/fast_double_parser/include" \
+         "$WORK/external_libs/fmt/include/fmt"
+
+cat > "$WORK/external_libs/fast_double_parser/include/fast_double_parser.h" <<'EOF'
+// strtod shim for the absent vendored fast_double_parser (oracle build only)
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end;
+  *out = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  return end;
+}
+}
+EOF
+
+cat > "$WORK/external_libs/fmt/include/fmt/format.h" <<'EOF'
+// snprintf shim for the absent vendored {fmt} (oracle build only); covers
+// the three format strings common.h uses: "{}", "{:g}", "{:.17g}"
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <type_traits>
+namespace fmt {
+template <typename OutIt> struct format_to_n_result { OutIt out; size_t size; };
+template <typename T>
+inline format_to_n_result<char*> format_to_n(char* buf, size_t n,
+                                             const char* f, T value) {
+  int len;
+  if (std::strcmp(f, "{:.17g}") == 0)
+    len = snprintf(buf, n, "%.17g", (double)value);
+  else if (std::strcmp(f, "{:g}") == 0)
+    len = snprintf(buf, n, "%g", (double)value);
+  else if (std::is_floating_point<T>::value)
+    len = snprintf(buf, n, "%g", (double)value);
+  else if (std::is_signed<T>::value)
+    len = snprintf(buf, n, "%lld", (long long)value);
+  else
+    len = snprintf(buf, n, "%llu", (unsigned long long)value);
+  size_t l = (size_t)(len < 0 ? 0 : len);
+  return {buf + (l < n ? l : n), l};
+}
+}
+EOF
+
+cmake -S "$WORK" -B "$BUILD" -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON
+cmake --build "$BUILD" --target lightgbm -j "$(nproc)"
+echo "oracle built: $WORK/lightgbm"
