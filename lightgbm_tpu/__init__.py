@@ -16,9 +16,12 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
+from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                      LGBMRegressor)
 from .utils.log import LightGBMError, register_callback
 
 __all__ = [
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "Booster",
     "Config",
     "Dataset",

@@ -1,0 +1,6 @@
+"""`python -m lightgbm_tpu config=train.conf` (ref: src/main.cpp:14)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

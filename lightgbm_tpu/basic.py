@@ -295,6 +295,81 @@ class Booster:
                                   pred_leaf=pred_leaf)
 
     # ------------------------------------------------------------------
+    def refit(self, data, label, weight=None, **kwargs) -> "Booster":
+        """Refit existing tree structures to new data (ref: basic.py
+        Booster.refit -> LGBM_BoosterRefit; gbdt.cpp:252 RefitTree)."""
+        if hasattr(data, "values"):
+            data = data.values
+        self._gbdt.refit(np.asarray(data, np.float64),
+                         np.asarray(label, np.float64), weight=weight)
+        return self
+
+    def model_to_if_else(self) -> str:
+        """Standalone C++ if-else predictor source
+        (ref: gbdt_model_text.cpp SaveModelToIfElse)."""
+        self._gbdt._sync_model()
+        trees = self._gbdt.models_
+        out = ["#include <cmath>", "", "namespace lightgbm_tpu {", ""]
+        for i, tree in enumerate(trees):
+            out.append(f"double PredictTree{i}(const double* row) {{")
+            ni = tree.num_leaves - 1
+
+            def emit(node, indent):
+                pad = "  " * indent
+                if node < 0:
+                    out.append(f"{pad}return {tree.leaf_value[~node]!r};")
+                    return
+                f = int(tree.split_feature[node])
+                thr = float(tree.threshold[node])
+                dt = int(tree.decision_type[node])
+                default_left = bool(dt & 2)
+                miss = "std::isnan(row[%d])" % f
+                if dt & 1:  # categorical membership
+                    cat = int(tree.threshold[node])
+                    s, e = (tree.cat_boundaries[cat],
+                            tree.cat_boundaries[cat + 1])
+                    words = ",".join(str(int(w))
+                                     for w in tree.cat_threshold[s:e])
+                    cond = (f"[&]{{ if ({miss} || row[{f}] < 0) return false;"
+                            f" unsigned v = (unsigned)row[{f}];"
+                            f" unsigned bits[] = {{{words}}};"
+                            f" return v/32 < {e - s}u &&"
+                            f" ((bits[v/32] >> (v%32)) & 1u); }}()")
+                else:
+                    base = f"row[{f}] <= {thr!r}"
+                    mt = (dt >> 2) & 3
+                    if mt == 2:  # nan
+                        cond = (f"({miss} ? {str(default_left).lower()}"
+                                f" : ({base}))")
+                    elif mt == 1:  # zero
+                        cond = (f"((std::fabs(row[{f}]) <= 1e-35)"
+                                f" ? {str(default_left).lower()} : ({base}))")
+                    else:
+                        cond = base
+                out.append(f"{pad}if ({cond}) {{")
+                emit(int(tree.left_child[node]), indent + 1)
+                out.append(f"{pad}}} else {{")
+                emit(int(tree.right_child[node]), indent + 1)
+                out.append(f"{pad}}}")
+
+            if tree.num_leaves <= 1:
+                out.append(f"  return {tree.leaf_value[0]!r};")
+            else:
+                emit(0, 1)
+            out.append("}")
+            out.append("")
+        out.append("double Predict(const double* row) {")
+        out.append("  double sum = 0.0;")
+        for i in range(len(trees)):
+            out.append(f"  sum += PredictTree{i}(row);")
+        if getattr(self._gbdt, "average_output_", False) and trees:
+            out.append(f"  sum /= {len(trees)}.0;")
+        out.append("  return sum;")
+        out.append("}")
+        out.append("")
+        out.append("}  // namespace lightgbm_tpu")
+        return "\n".join(out)
+
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
                         importance_type: str = "split") -> str:
         return save_model_to_string(self._gbdt, num_iteration, start_iteration,

@@ -1024,6 +1024,56 @@ class GBDT:
             return np.asarray(self.objective.convert_output(jnp_.asarray(raw.T))).T
         return np.asarray(self.objective.convert_output(jnp_.asarray(raw)))
 
+    def refit(self, X: np.ndarray, label: np.ndarray,
+              weight: Optional[np.ndarray] = None) -> None:
+        """Refit the existing tree structures' leaf values to new data
+        (ref: gbdt.cpp:252 RefitTree; serial_tree_learner.cpp:241
+        FitByExistingTree: new_leaf = decay*old + (1-decay)*output*shrink)."""
+        self._sync_model()
+        import jax.numpy as jnp_
+        from ..io.dataset import Metadata
+        from ..objective import create_objective
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        cfg = self.config
+        decay = cfg.refit_decay_rate
+        leaf_preds = self.predict_leaf_index(X)        # [n, num_trees]
+        md = Metadata(n)
+        md.set_label(np.asarray(label, np.float64))
+        if weight is not None:
+            md.set_weight(weight)
+        obj = self.objective or create_objective(cfg)
+        obj.init(md, n)
+        lab = jnp_.asarray(np.asarray(obj.label, np.float32))
+        w = (None if md.weight is None
+             else jnp_.asarray(np.asarray(md.weight, np.float32)))
+        score = np.zeros((K, n), np.float64)
+        num_iters = len(self.models_) // K
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        for it in range(num_iters):
+            sc = jnp_.asarray(score.astype(np.float32))
+            g, h = obj.get_gradients(sc if K > 1 else sc[0], lab, w)
+            g = np.asarray(g).reshape(K, n)
+            h = np.asarray(h).reshape(K, n)
+            for k in range(K):
+                m = it * K + k
+                tree = self.models_[m]
+                nl = tree.num_leaves
+                lp = np.clip(leaf_preds[:, m], 0, nl - 1)
+                sg = np.bincount(lp, weights=g[k], minlength=nl)[:nl]
+                sh = np.bincount(lp, weights=h[k], minlength=nl)[:nl] + K_EPSILON
+                sg_l1 = np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0)
+                out = -sg_l1 / (sh + l2)
+                if cfg.max_delta_step > 0:
+                    out = np.clip(out, -cfg.max_delta_step,
+                                  cfg.max_delta_step)
+                new = (decay * tree.leaf_value[:nl]
+                       + (1.0 - decay) * out * tree.shrinkage)
+                tree.leaf_value[:nl] = new
+                tree.leaf_count[:nl] = np.bincount(lp, minlength=nl)[:nl]
+                score[k] += new[lp]
+
     def predict_contrib(self, X: np.ndarray, start_iteration: int = 0,
                         num_iteration: int = -1) -> np.ndarray:
         """SHAP feature contributions [n, K*(F+1)]: per class, F per-feature

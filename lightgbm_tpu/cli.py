@@ -1,0 +1,142 @@
+"""CLI application: `python -m lightgbm_tpu config=train.conf [k=v ...]`.
+
+TPU-native analogue of the reference CLI (ref: src/main.cpp:14;
+src/application/application.cpp:31 Application / application.h:78 Run).
+Parameter precedence matches LoadParameters: command-line `key=value`
+pairs win over config-file entries (first occurrence wins,
+ref: application.cpp:79 KeepFirstValues).  Tasks: train, predict,
+refit, save_binary, convert_model (ref: config.h TaskType).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, read_config_file
+from .engine import train as train_api
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """argv `key=value` tokens + optional config file, CLI first
+    (ref: application.cpp:50-86 LoadParameters)."""
+    params: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            log.fatal(f"Unknown argument {tok!r}; expected key=value")
+        k, v = tok.split("=", 1)
+        params.setdefault(k.strip(), v.strip())
+    conf = params.get("config", params.get("config_file", ""))
+    if conf:
+        for k, v in read_config_file(conf).items():
+            params.setdefault(k, v)  # first (CLI) value wins
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+def _load_train_data(cfg: Config, params: Dict[str, str]) -> Dataset:
+    if not cfg.data:
+        log.fatal("No training data: set data=<file>")
+    return Dataset(cfg.data, params=dict(params))
+
+
+def _task_train(cfg: Config, params: Dict[str, str]) -> None:
+    train_set = _load_train_data(cfg, params)
+    valid_sets, valid_names = [], []
+    for i, vf in enumerate(cfg.valid):
+        valid_sets.append(Dataset(vf, params=dict(params),
+                                  reference=train_set))
+        valid_names.append(f"valid_{i}" if len(cfg.valid) > 1 else "valid")
+    init_model = cfg.input_model or None
+    booster = train_api(dict(params), train_set,
+                        num_boost_round=cfg.num_iterations,
+                        valid_sets=valid_sets or None,
+                        valid_names=valid_names or None,
+                        init_model=init_model)
+    booster.save_model(cfg.output_model)
+    log.info(f"Finished training; model saved to {cfg.output_model}")
+
+
+def _load_predict_matrix(cfg: Config) -> np.ndarray:
+    from .io.parser import parse_file
+    feats, _, _ = parse_file(cfg.data, has_header=cfg.header,
+                             label_column=cfg.label_column)
+    return feats
+
+
+def _task_predict(cfg: Config, params: Dict[str, str]) -> None:
+    if not cfg.input_model:
+        log.fatal("task=predict needs input_model=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    X = _load_predict_matrix(cfg)
+    pred = booster.predict(
+        X, raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index,
+        pred_contrib=cfg.predict_contrib,
+        num_iteration=cfg.num_iteration_predict)
+    with open(cfg.output_result, "w") as f:
+        for row in np.atleast_1d(pred):
+            if np.ndim(row) == 0:
+                f.write(f"{row:.18g}\n")
+            else:
+                f.write("\t".join(f"{v:.18g}" for v in row) + "\n")
+    log.info(f"Finished prediction; results saved to {cfg.output_result}")
+
+
+def _task_refit(cfg: Config, params: Dict[str, str]) -> None:
+    """Refit existing tree structures to new data
+    (ref: application.cpp ConvertModel... task=refit -> GBDT::RefitTree)."""
+    if not cfg.input_model:
+        log.fatal("task=refit needs input_model=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    from .io.parser import parse_file
+    feats, labels, _ = parse_file(cfg.data, has_header=cfg.header,
+                                  label_column=cfg.label_column)
+    booster.refit(feats, labels)
+    booster.save_model(cfg.output_model)
+    log.info(f"Finished refit; model saved to {cfg.output_model}")
+
+
+def _task_save_binary(cfg: Config, params: Dict[str, str]) -> None:
+    ds = _load_train_data(cfg, params)
+    core = ds._core_or_construct()
+    out = (cfg.data or "train") + ".bin"
+    core.save_binary(out)
+    log.info(f"Saved binary dataset to {out}")
+
+
+def _task_convert_model(cfg: Config, params: Dict[str, str]) -> None:
+    """Model -> standalone C-like if-else source
+    (ref: gbdt_model_text.cpp SaveModelToIfElse)."""
+    if not cfg.input_model:
+        log.fatal("task=convert_model needs input_model=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    out = cfg.convert_model or "gbdt_prediction.cpp"
+    with open(out, "w") as f:
+        f.write(booster.model_to_if_else())
+    log.info(f"Converted model saved to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    params = parse_args(argv)
+    cfg = Config(dict(params))
+    task = cfg.task
+    handlers = {"train": _task_train, "predict": _task_predict,
+                "prediction": _task_predict, "refit": _task_refit,
+                "refit_tree": _task_refit,
+                "save_binary": _task_save_binary,
+                "convert_model": _task_convert_model}
+    if task not in handlers:
+        log.fatal(f"Unknown task {task!r}")
+    handlers[task](cfg, params)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

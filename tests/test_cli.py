@@ -1,0 +1,97 @@
+"""CLI application (ref: src/main.cpp; application.cpp:31;
+examples/*/train.conf are parsed directly)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import main, parse_args
+
+EXAMPLES = "/root/reference/examples"
+BINARY = f"{EXAMPLES}/binary_classification"
+
+
+def test_parse_args_precedence(tmp_path):
+    conf = tmp_path / "c.conf"
+    conf.write_text("num_leaves = 31\nlearning_rate = 0.05\n# comment\n")
+    params = parse_args([f"config={conf}", "num_leaves=7", "data=x.txt"])
+    assert params["num_leaves"] == "7"       # CLI wins over config file
+    assert params["learning_rate"] == "0.05"
+    assert params["data"] == "x.txt"
+
+
+def test_train_and_predict_roundtrip(tmp_path):
+    model = tmp_path / "model.txt"
+    out = tmp_path / "preds.txt"
+    rc = main([f"data={BINARY}/binary.train", "objective=binary",
+               "num_iterations=15", "num_leaves=31", "verbosity=-1",
+               f"output_model={model}"])
+    assert rc == 0 and model.exists()
+    rc = main(["task=predict", f"data={BINARY}/binary.test",
+               f"input_model={model}", f"output_result={out}",
+               "verbosity=-1"])
+    assert rc == 0
+    preds = np.loadtxt(out)
+    y = np.loadtxt(f"{BINARY}/binary.test")[:, 0]
+    assert preds.shape == y.shape
+    assert 0 <= preds.min() and preds.max() <= 1
+    acc = np.mean((preds > 0.5) == (y > 0.5))
+    assert acc > 0.7, acc
+
+
+def test_train_with_reference_example_conf(tmp_path):
+    """The reference's own train.conf files must parse and run."""
+    model = tmp_path / "model.txt"
+    rc = main([f"config={BINARY}/train.conf",
+               f"data={BINARY}/binary.train",
+               f"valid={BINARY}/binary.test",
+               "num_iterations=3", f"output_model={model}",
+               "verbosity=-1"])
+    assert rc == 0 and model.exists()
+    text = model.read_text()
+    assert text.startswith("tree\n")
+
+
+def test_cli_refit(tmp_path):
+    model = tmp_path / "model.txt"
+    refitted = tmp_path / "model2.txt"
+    main([f"data={BINARY}/binary.train", "objective=binary",
+          "num_iterations=3", "num_leaves=15", "verbosity=-1",
+          f"output_model={model}"])
+    rc = main(["task=refit", f"data={BINARY}/binary.train",
+               f"input_model={model}", f"output_model={refitted}",
+               "verbosity=-1"])
+    assert rc == 0 and refitted.exists()
+    assert refitted.read_text() != model.read_text()
+
+
+def test_cli_convert_model(tmp_path):
+    model = tmp_path / "model.txt"
+    cpp = tmp_path / "pred.cpp"
+    main([f"data={BINARY}/binary.train", "objective=binary",
+          "num_iterations=2", "num_leaves=7", "verbosity=-1",
+          f"output_model={model}"])
+    rc = main(["task=convert_model", f"input_model={model}",
+               f"convert_model={cpp}", "verbosity=-1"])
+    assert rc == 0
+    src = cpp.read_text()
+    assert "double Predict(const double* row)" in src
+    assert "PredictTree0" in src
+
+
+def test_python_dash_m_entrypoint(tmp_path):
+    model = tmp_path / "model.txt"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu",
+         f"data={BINARY}/binary.train", "objective=binary",
+         "num_iterations=2", "num_leaves=7", "verbosity=-1",
+         f"output_model={model}"],
+        capture_output=True, text=True, timeout=300,
+        cwd="/root/repo", env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert model.exists()

@@ -1,0 +1,107 @@
+"""sklearn estimator API (ref: python-package/lightgbm/sklearn.py;
+tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cls_data(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(int)
+    return X, y
+
+
+def test_classifier_binary():
+    X, y = _cls_data()
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, y)
+    assert clf.n_features_ == 6
+    assert list(clf.classes_) == [0, 1]
+    acc = float(np.mean(clf.predict(X) == y))
+    assert acc > 0.9, acc
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_classifier_string_labels():
+    X, y = _cls_data(800)
+    labels = np.array(["neg", "pos"])[y]
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=7)
+    clf.fit(X, labels)
+    assert set(clf.predict(X)) <= {"neg", "pos"}
+    acc = float(np.mean(clf.predict(X) == labels))
+    assert acc > 0.85, acc
+
+
+def test_classifier_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 4)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=15, num_leaves=15)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (1500, 3)
+    acc = float(np.mean(clf.predict(X) == y))
+    assert acc > 0.85, acc
+
+
+def test_regressor_with_eval_and_early_stopping():
+    rng = np.random.RandomState(2)
+    X = rng.randn(2000, 5)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(2000)
+    reg = lgb.LGBMRegressor(n_estimators=200, num_leaves=15,
+                            early_stopping_round=5)
+    reg.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])])
+    assert reg.best_iteration_ > 0
+    mse = float(np.mean((reg.predict(X[1500:]) - y[1500:]) ** 2))
+    assert mse < 0.1, mse
+
+
+def test_regressor_sklearn_params_roundtrip():
+    reg = lgb.LGBMRegressor(num_leaves=63, learning_rate=0.05,
+                            min_child_samples=7, reg_lambda=0.5)
+    params = reg.get_params()
+    assert params["num_leaves"] == 63
+    assert params["reg_lambda"] == 0.5
+    reg.set_params(num_leaves=31)
+    assert reg.get_params()["num_leaves"] == 31
+
+
+def test_sklearn_clone_and_cv_compat():
+    from sklearn.base import clone
+    from sklearn.model_selection import cross_val_score
+    X, y = _cls_data(900)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7)
+    clf2 = clone(clf)
+    assert clf2.get_params()["n_estimators"] == 5
+    scores = cross_val_score(clf, X, y, cv=2)
+    assert scores.mean() > 0.8, scores
+
+
+def test_feature_importances():
+    X, y = _cls_data()
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=15).fit(X, y)
+    imp = clf.feature_importances_
+    assert imp.shape == (6,)
+    assert imp[:3].sum() > imp[3:].sum()  # informative features dominate
+
+
+def test_ranker():
+    rng = np.random.RandomState(3)
+    n_queries, per_q = 60, 20
+    n = n_queries * per_q
+    X = rng.rand(n, 4)
+    rel = (3 * X[:, 0] + rng.rand(n) > 2).astype(int) + (X[:, 1] > 0.8)
+    group = np.full(n_queries, per_q)
+    rk = lgb.LGBMRanker(n_estimators=10, num_leaves=7,
+                        min_child_samples=5)
+    rk.fit(X, rel, group=group)
+    s = rk.predict(X)
+    # scores must rank relevant docs above irrelevant within queries
+    corr = np.corrcoef(s, rel)[0, 1]
+    assert corr > 0.5, corr
