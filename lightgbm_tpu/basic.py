@@ -288,11 +288,14 @@ class Booster:
                 np.asarray(data, np.float64),
                 start_iteration=start_iteration,
                 num_iteration=num_iteration)
+        pred_kwargs = {k: v for k, v in kwargs.items()
+                       if k in ("pred_early_stop", "pred_early_stop_freq",
+                                "pred_early_stop_margin")}
         return self._gbdt.predict(np.asarray(data, np.float64),
                                   raw_score=raw_score,
                                   start_iteration=start_iteration,
                                   num_iteration=num_iteration,
-                                  pred_leaf=pred_leaf)
+                                  pred_leaf=pred_leaf, **pred_kwargs)
 
     # ------------------------------------------------------------------
     def refit(self, data, label, weight=None, **kwargs) -> "Booster":

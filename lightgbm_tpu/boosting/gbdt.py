@@ -27,6 +27,7 @@ from ..objective import ObjectiveFunction
 from ..ops.split import SplitParams
 from ..metric import Metric
 from ..utils import log
+from ..utils.timer import global_timer
 
 K_EPSILON = 1e-15
 _PAD = 1024  # row padding multiple (histogram chunking requirement)
@@ -277,7 +278,9 @@ class GBDT:
                 cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
                 min_data_per_group=config.min_data_per_group,
                 has_monotone=has_mono,
-                monotone_penalty=config.monotone_penalty),
+                monotone_penalty=config.monotone_penalty,
+                extra_trees=config.extra_trees,
+                extra_seed=config.extra_seed),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -648,7 +651,8 @@ class GBDT:
             hess = jnp.asarray(_pad_rows(np.asarray(hessians, np.float32)
                                          .reshape(K, -1), self.n_pad))
 
-        bag_mask, grad, hess = self._update_bagging(grad, hess)
+        with global_timer.scope("GBDT::bagging"):
+            bag_mask, grad, hess = self._update_bagging(grad, hess)
         should_continue = False
         for k in range(K):
             tree = None
@@ -662,12 +666,14 @@ class GBDT:
                         g_k, h_k, np.int32(self.iter_ * K + k))
                 else:
                     gq, hq = g_k, h_k
-                arrays, leaf_id = self._grow_fn(
-                    self.binned_dev, gq, hq, bag_mask,
-                    self._col_mask(), self.meta, self.grow_params)
-                tree = self._finalize_tree(arrays, leaf_id, k,
-                                           init_scores[k],
-                                           float_grads=(g_k, h_k))
+                with global_timer.scope("GBDT::grow_tree"):
+                    arrays, leaf_id = self._grow_fn(
+                        self.binned_dev, gq, hq, bag_mask,
+                        self._col_mask(), self.meta, self.grow_params)
+                with global_timer.scope("GBDT::finalize_tree"):
+                    tree = self._finalize_tree(arrays, leaf_id, k,
+                                               init_scores[k],
+                                               float_grads=(g_k, h_k))
             if tree is None:
                 if len(self.models_) < K:
                     tree = self._make_const_stump(k)
@@ -993,8 +999,13 @@ class GBDT:
 
     # ---------------------------------------------------------------- predict
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
-        """Raw scores [n] or [n, K] (ref: gbdt_prediction.cpp PredictRaw)."""
+                    num_iteration: int = -1, pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
+        """Raw scores [n] or [n, K] (ref: gbdt_prediction.cpp PredictRaw;
+        early stopping per prediction_early_stop.cpp: rows whose margin
+        exceeds the threshold every round_period iterations keep their
+        partial sum — binary margin = 2|score|, multiclass = top1-top2)."""
         self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]
@@ -1004,19 +1015,35 @@ class GBDT:
             num_iteration = total_iters - start_iteration
         end = min(start_iteration + num_iteration, total_iters)
         out = np.zeros((K, n))
-        for it in range(start_iteration, end):
+        use_es = pred_early_stop and not self.average_output_
+        active = np.ones(n, bool) if use_es else None
+        for i, it in enumerate(range(start_iteration, end)):
+            if use_es and i > 0 and i % pred_early_stop_freq == 0:
+                if K == 1:
+                    margin = 2.0 * np.abs(out[0])
+                else:
+                    top2 = np.partition(out, K - 2, axis=0)[K - 2:]
+                    margin = top2[1] - top2[0]
+                active &= margin <= pred_early_stop_margin
+                if not active.any():
+                    break
             for k in range(K):
-                out[k] += self.models_[it * K + k].predict(X)
+                pred = self.models_[it * K + k].predict(X)
+                if use_es:
+                    out[k][active] += pred[active]
+                else:
+                    out[k] += pred
         if self.average_output_ and end > start_iteration:
             out /= end - start_iteration  # ref: gbdt_prediction.cpp:57
         return out[0] if K == 1 else out.T
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False) -> np.ndarray:
+                pred_leaf: bool = False, **pred_kwargs) -> np.ndarray:
         if pred_leaf:
             return self.predict_leaf_index(X, start_iteration, num_iteration)
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+        raw = self.predict_raw(X, start_iteration, num_iteration,
+                               **pred_kwargs)
         if raw_score or self.objective is None:
             return raw
         import jax.numpy as jnp_

@@ -201,13 +201,27 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                    1.0 - pen / jnp.exp2(d) + eps,
                                    1.0 - jnp.exp2(pen - 1.0 - d) + eps))
 
+    if sp.extra_trees:
+        _extra_key = jax.random.PRNGKey(sp.extra_seed)
+
+    def _rand_bins(tag):
+        """One random threshold per feature for this leaf scan
+        (ref: feature_histogram.hpp:204 rand.NextInt(0, num_bin - 2))."""
+        u = jax.random.uniform(jax.random.fold_in(_extra_key, tag),
+                               (num_features,))
+        span = jnp.maximum(meta.num_bin - 2, 1).astype(f32)
+        return jnp.minimum((u * span).astype(jnp.int32),
+                           meta.num_bin - 3).astype(jnp.int32)
+
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
-                depth=None):
+                depth=None, rand_tag=0):
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
                       constraint_max=cmax,
                       mono_penalty=mono_penalty_of(depth))
+        if sp.extra_trees:
+            kw["rand_bin"] = _rand_bins(rand_tag)
         return find_best_split(hist, meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
                                sum_g, sum_h, cnt, parent_out, sp,
@@ -253,7 +267,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     inf = jnp.asarray(jnp.inf, f32)
     root_best = best_of(root_hist, sum_g0, sum_h0, cnt0,
                         jnp.asarray(0.0, f32), -inf, inf,
-                        jnp.asarray(0, jnp.int32))
+                        jnp.asarray(0, jnp.int32), rand_tag=0)
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -500,10 +514,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 l_min = l_max = r_min = r_max = None
 
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
-                             pd.left_output[best_leaf], l_min, l_max, depth)
+                             pd.left_output[best_leaf], l_min, l_max, depth,
+                             rand_tag=2 * i + 1)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
-                             depth)
+                             depth, rand_tag=2 * i + 2)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,

@@ -100,6 +100,18 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return _hist_wave_xla(binned, leaf_id, gh, max_bin=B,
                               num_slots=num_slots)
 
+    if sp.extra_trees:
+        _extra_key = jax.random.PRNGKey(sp.extra_seed)
+
+        def _rand_bins(tag):
+            """[NLp_max, F] random thresholds for this wave's leaf scans
+            (ref: feature_histogram.hpp:204 USE_RAND)."""
+            u = jax.random.uniform(jax.random.fold_in(_extra_key, tag),
+                                   (Lp, num_features))
+            span = jnp.maximum(meta.num_bin - 2, 1).astype(f32)[None, :]
+            return jnp.minimum((u * span).astype(jnp.int32),
+                               (meta.num_bin - 3)[None, :]).astype(jnp.int32)
+
     if sp.has_monotone:
         def _pen_of(depth):
             """ref: monotone_constraints.hpp:357."""
@@ -110,20 +122,26 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                        1.0 - jnp.exp2(pen - 1.0 - d)
                                        + 1e-15))
 
-        best_vm = jax.vmap(
-            lambda h, sg, sh, c, po, cmin, cmax, dep: find_best_split(
-                h, meta.num_bin, meta.missing_type, meta.default_bin,
-                meta.penalty, col_mask, sg, sh, c, po, sp,
-                is_cat_feature=meta.is_cat, monotone=meta.monotone,
-                constraint_min=cmin, constraint_max=cmax,
-                mono_penalty=_pen_of(dep)),
-            in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
-    else:
-        best_vm = jax.vmap(
-            lambda h, sg, sh, c, po: find_best_split(
-                h, meta.num_bin, meta.missing_type, meta.default_bin,
-                meta.penalty, col_mask, sg, sh, c, po, sp,
-                is_cat_feature=meta.is_cat))
+        pass
+
+    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb):
+        kw = {}
+        if sp.has_monotone:
+            kw = dict(monotone=meta.monotone, constraint_min=cmin,
+                      constraint_max=cmax, mono_penalty=_pen_of(dep))
+        if sp.extra_trees:
+            kw["rand_bin"] = rb
+        return find_best_split(
+            h, meta.num_bin, meta.missing_type, meta.default_bin,
+            meta.penalty, col_mask, sg, sh, c, po, sp,
+            is_cat_feature=meta.is_cat, **kw)
+
+    best_vm = jax.vmap(_best_one,
+                       in_axes=(0, 0, 0, 0, 0,
+                                0 if sp.has_monotone else None,
+                                0 if sp.has_monotone else None,
+                                0 if sp.has_monotone else None,
+                                0 if sp.extra_trees else None))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
@@ -172,13 +190,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hists, fcounts = hists_of(leaf_id, NLp)       # [NLp, F, B, 2], [NLp]
         counts = jnp.round(fcounts).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
-        if sp.has_monotone:
-            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                           counts, leaf_out[:NLp], leaf_cmin[:NLp],
-                           leaf_cmax[:NLp], tree.leaf_depth[:NLp])
-        else:
-            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                           counts, leaf_out[:NLp])    # SplitResult over [NLp]
+        rb = (_rand_bins(tree.num_leaves)[:NLp] if sp.extra_trees else None)
+        mono_args = ((leaf_cmin[:NLp], leaf_cmax[:NLp],
+                      tree.leaf_depth[:NLp]) if sp.has_monotone
+                     else (None, None, None))
+        best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                       counts, leaf_out[:NLp], *mono_args, rb)
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget

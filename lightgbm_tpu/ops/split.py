@@ -71,6 +71,11 @@ class SplitParams(NamedTuple):
     # ComputeMonotoneSplitGainPenalty (monotone_constraints.hpp:357).
     has_monotone: bool = False
     monotone_penalty: float = 0.0
+    # extra-trees mode (ref: feature_histogram.hpp:192 USE_RAND): each
+    # numerical feature is evaluated at ONE random threshold per leaf scan
+    # instead of the full sweep; extra_seed seeds the per-scan draw
+    extra_trees: bool = False
+    extra_seed: int = 6
     max_cat_threshold: int = 32
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
@@ -265,6 +270,7 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     num_data: jnp.ndarray, parent_output: jnp.ndarray,
                     params: SplitParams,
                     is_cat_feature: jnp.ndarray = None,
+                    rand_bin: jnp.ndarray = None,
                     monotone: jnp.ndarray = None,
                     constraint_min: jnp.ndarray = None,
                     constraint_max: jnp.ndarray = None,
@@ -352,6 +358,10 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     # (ref: hpp:856-930), so left sums are the inclusive prefix at tau.
     rev_tau_ok = (bins <= nb - 2 - na_extra) & in_range
     rev_tau_ok &= ~((mt == MISSING_ZERO) & (bins == db - 1))  # skipped iteration
+    if params.extra_trees:
+        # only the leaf's random threshold is a candidate (USE_RAND:
+        # hpp:899 `t - 1 + offset != rand_threshold -> continue`)
+        rev_tau_ok &= bins == rand_bin[:, None]
     # REVERSE accumulates right_h = kEps + suffix; left_h = sum_h - right_h.
     # eval_candidates re-adds its own eps to the raw left, so raw subtracts both.
     rev_left_g = sum_g - (tg - pg)
@@ -366,6 +376,8 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     # ---- FORWARD scan: left = inclusive prefix at tau; missing goes right ----
     fwd_tau_ok = (bins <= nb - 2) & in_range & (mt != MISSING_NONE)
     fwd_tau_ok &= ~((mt == MISSING_ZERO) & (bins == db))      # skipped iteration
+    if params.extra_trees:
+        fwd_tau_ok &= bins == rand_bin[:, None]
     fwd_gain = eval_candidates(pg, ph, pc, fwd_tau_ok)
     fwd_best_idx = jnp.argmax(fwd_gain, axis=1).astype(jnp.int32)
     fwd_best_gain = jnp.take_along_axis(fwd_gain, fwd_best_idx[:, None], 1)[:, 0]

@@ -1,4 +1,5 @@
 from . import log
 from .log import LightGBMError
+from .timer import Timer, global_timer
 
-__all__ = ["log", "LightGBMError"]
+__all__ = ["log", "LightGBMError", "Timer", "global_timer"]

@@ -1,0 +1,84 @@
+"""Named timing scopes aggregated in a global timer.
+
+TPU-native analogue of the reference's TIMETAG instrumentation
+(ref: include/LightGBM/utils/common.h:973-1010 Timer/FunctionTimer,
+instantiated as `global_timer` in src/boosting/gbdt.cpp:22 and printed at
+process exit).  Enabled by the LIGHTGBM_TPU_TIMETAG env var (the
+reference's compile-time flag becomes a runtime switch); scopes can also
+emit jax.profiler TraceAnnotations so device timelines in a profiler
+carry the same names.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Tuple
+
+
+class Timer:
+    """Aggregates wall-clock per named scope (ref: common.h:973 Timer)."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._acc: Dict[str, float] = defaultdict(float)
+        self._cnt: Dict[str, int] = defaultdict(int)
+        self._use_jax_profiler = False
+
+    @contextmanager
+    def scope(self, name: str):
+        """RAII scope (ref: common.h:1000 FunctionTimer)."""
+        if not self.enabled:
+            yield
+            return
+        if self._use_jax_profiler:
+            import jax.profiler
+            ctx = jax.profiler.TraceAnnotation(name)
+        else:
+            ctx = None
+        t0 = time.perf_counter()
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            yield
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            self._acc[name] += time.perf_counter() - t0
+            self._cnt[name] += 1
+
+    def timeit(self, name: str):
+        """Decorator form."""
+        def deco(fn):
+            def wrapped(*a, **k):
+                with self.scope(name):
+                    return fn(*a, **k)
+            return wrapped
+        return deco
+
+    def items(self) -> Tuple[Tuple[str, float, int], ...]:
+        return tuple((k, self._acc[k], self._cnt[k])
+                     for k in sorted(self._acc, key=self._acc.get,
+                                     reverse=True))
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._cnt.clear()
+
+    def print(self) -> None:
+        """ref: Timer::Print at process exit."""
+        if not self._acc:
+            return
+        from . import log
+        log.info("LightGBM-TPU timers:")
+        for name, sec, cnt in self.items():
+            log.info(f"  {name}: {sec * 1000:.3f} ms ({cnt} calls)")
+
+
+global_timer = Timer(
+    enabled=bool(os.environ.get("LIGHTGBM_TPU_TIMETAG", "")))
+if global_timer.enabled:
+    atexit.register(global_timer.print)

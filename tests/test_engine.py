@@ -192,3 +192,56 @@ def test_predict_leaf_index():
     leaves = booster.predict(X, pred_leaf=True)
     assert leaves.shape == (500, 5)
     assert leaves.max() < 7
+
+
+def test_extra_trees():
+    """extra_trees evaluates one random threshold per feature per leaf
+    (ref: feature_histogram.hpp:192 USE_RAND): trees differ from the
+    exhaustive scan but the model still learns."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(3000, 5)
+    y = (2 * (X[:, 0] > 0.4) + X[:, 1] + 0.1 * rng.randn(3000))
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b_norm = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=15)
+    b_et = lgb.train({**base, "extra_trees": True},
+                     lgb.Dataset(X, label=y), num_boost_round=15)
+    from lightgbm_tpu.boosting.model_io import save_model_to_string
+    assert (save_model_to_string(b_norm._gbdt)
+            != save_model_to_string(b_et._gbdt))
+    mse_et = float(np.mean((b_et.predict(X) - y) ** 2))
+    mse_norm = float(np.mean((b_norm.predict(X) - y) ** 2))
+    assert mse_et < mse_norm * 3.0, (mse_et, mse_norm)
+
+
+def test_extra_trees_wave_engine():
+    rng = np.random.RandomState(6)
+    X = rng.rand(2000, 4)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                   "extra_trees": True, "tpu_growth_strategy": "wave",
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    acc = float(np.mean((b.predict(X) > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+
+
+def test_pred_early_stop():
+    """pred_early_stop freezes decisive rows' partial sums
+    (ref: prediction_early_stop.cpp CreateBinary: margin = 2|score|)."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(1500, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "learning_rate": 0.3},
+                  lgb.Dataset(X, label=y), num_boost_round=40)
+    full = b.predict(X)
+    es = b.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                   pred_early_stop_margin=1.0)
+    # same class decisions, different (partial) probabilities on easy rows
+    assert np.mean((full > 0.5) == (es > 0.5)) > 0.98
+    assert not np.allclose(full, es)
+    # a huge margin disables stopping entirely
+    es_off = b.predict(X, pred_early_stop=True,
+                       pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(full, es_off, rtol=1e-12)

@@ -1,0 +1,43 @@
+"""Timer/profiling subsystem (ref: utils/common.h:973 Timer/FunctionTimer,
+global_timer printed at exit when TIMETAG is on)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.timer import Timer, global_timer
+
+
+def test_timer_scopes_aggregate():
+    t = Timer(enabled=True)
+    with t.scope("a"):
+        with t.scope("b"):
+            pass
+    with t.scope("a"):
+        pass
+    items = dict((k, c) for k, _, c in t.items())
+    assert items == {"a": 2, "b": 1}
+
+
+def test_timer_disabled_is_noop():
+    t = Timer(enabled=False)
+    with t.scope("a"):
+        pass
+    assert t.items() == ()
+
+
+def test_global_timer_instruments_training():
+    global_timer.enabled = True
+    global_timer.reset()
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.randn(500, 3)
+        y = X[:, 0]
+        lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=2)
+        names = {k for k, _, _ in global_timer.items()}
+        assert "GBDT::grow_tree" in names
+        assert "GBDT::finalize_tree" in names
+    finally:
+        global_timer.enabled = False
+        global_timer.reset()
