@@ -425,6 +425,12 @@ class GBDT:
         # bf16 multiply / fp32 add).  The reference's 8/16/32-bit histogram
         # bin-width selection (SetNumBitsInHistogramBin) is a CPU memory
         # optimization with no TPU analogue.
+        if config.linear_tree and objective is not None and getattr(
+                objective, "need_renew_tree_output", False):
+            # ref: config.cpp "Cannot use regression_l1 objective for
+            # linear tree" (renewal overwrites the fitted leaf models)
+            log.fatal(f"Cannot use objective {config.objective!r} "
+                      "with linear_tree")
         self.use_quant = config.use_quantized_grad
         if self.use_quant:
             qhalf = max(config.num_grad_quant_bins // 2, 1)
@@ -865,6 +871,30 @@ class GBDT:
         packed buffer has settled — the boosting loop never blocks on D2H.
         """
         obj = self.objective
+        if self.config.linear_tree:
+            # linear leaves (ref: linear_tree_learner.cpp:184
+            # CalculateLinear runs after the structure is grown, before
+            # shrinkage; scores then need the full linear prediction)
+            tree = self._arrays_to_tree(arrays)
+            if tree is None:
+                return None
+            g, h = float_grads
+            bag = self._bag_mask_host[:self.num_data]
+            self._calculate_linear(
+                tree, np.asarray(leaf_id)[:self.num_data],
+                np.asarray(g)[:self.num_data] * bag,
+                np.asarray(h)[:self.num_data] * bag)
+            tree.apply_shrinkage(self.shrinkage_rate)
+            X = self._raw_or_reconstruct(self.train_data)
+            delta = tree.predict(np.asarray(X, np.float64))
+            self.scores = self._score_add_fn(
+                self.scores, class_id,
+                jnp.asarray(_pad_rows(delta.astype(np.float32),
+                                      self.n_pad)))
+            self._add_tree_score(tree, class_id, train=False)
+            if abs(init_score) > K_EPSILON:
+                tree.add_bias(init_score)
+            return tree
         if (self.use_quant and self.config.quant_train_renew_leaf
                 and float_grads is not None):
             # quantized leaf renewal runs first, then any objective renewal
@@ -978,8 +1008,13 @@ class GBDT:
                 jnp.asarray(_pad_rows(ids, self.n_pad)), self.pad_mask)
         if valid:
             for vi, vds in enumerate(self.valid_sets):
-                vids = self._tree_leaf_ids(tree, vds.binned)
-                self.valid_scores[vi][class_id] += tree.leaf_value[vids]
+                if tree.is_linear:
+                    vX = self._raw_or_reconstruct(vds)
+                    self.valid_scores[vi][class_id] += tree.predict(
+                        np.asarray(vX, np.float64))
+                else:
+                    vids = self._tree_leaf_ids(tree, vds.binned)
+                    self.valid_scores[vi][class_id] += tree.leaf_value[vids]
 
     # ------------------------------------------------------------------- eval
     def eval_train(self):
@@ -1050,6 +1085,68 @@ class GBDT:
         if raw.ndim == 2:
             return np.asarray(self.objective.convert_output(jnp_.asarray(raw.T))).T
         return np.asarray(self.objective.convert_output(jnp_.asarray(raw)))
+
+    def _calculate_linear(self, tree: Tree, leaf_id: np.ndarray,
+                          grad: np.ndarray, hess: np.ndarray) -> None:
+        """Fit linear leaf models by weighted ridge normal equations
+        (ref: linear_tree_learner.cpp:184 CalculateLinear, Eq 3 of
+        arXiv:1802.05640: coeffs = -(X'HX + lambda)^-1 X'g over the leaf's
+        numerical branch features plus a constant column; rows with NaN in
+        any branch feature are excluded; degenerate leaves keep
+        leaf_value as the constant)."""
+        from ..io.binning import BIN_NUMERICAL
+        cfg = self.config
+        ds = self.train_data
+        raw = self._raw_or_reconstruct(ds)
+        tree.is_linear = True
+        nl = tree.num_leaves
+        # branch features per leaf: climb the parent chain
+        for leaf in range(nl):
+            feats = []
+            node = tree.leaf_parent[leaf]
+            while node >= 0:
+                feats.append(int(tree.split_feature[node]))
+                # find this node's parent: scan child pointers
+                parents = np.nonzero(
+                    (tree.left_child[:nl - 1] == node)
+                    | (tree.right_child[:nl - 1] == node))[0]
+                node = int(parents[0]) if len(parents) else -1
+            feats = sorted(set(
+                f for f in feats
+                if ds.bin_mappers[f].bin_type == BIN_NUMERICAL))
+            rows = np.nonzero((leaf_id == leaf) & (hess > 0))[0]
+            k = len(feats)
+            if len(rows) == 0:
+                tree.leaf_const[leaf] = tree.leaf_value[leaf]
+                tree.leaf_features[leaf] = []
+                tree.leaf_features_inner[leaf] = []
+                tree.leaf_coeff[leaf] = []
+                continue
+            Xl = raw[np.ix_(rows, feats)] if k else np.zeros((len(rows), 0))
+            ok = ~np.isnan(Xl).any(axis=1)
+            if ok.sum() < k + 1:
+                tree.leaf_const[leaf] = tree.leaf_value[leaf]
+                tree.leaf_features[leaf] = []
+                tree.leaf_features_inner[leaf] = []
+                tree.leaf_coeff[leaf] = []
+                continue
+            Xd = np.column_stack([Xl[ok], np.ones(int(ok.sum()))])
+            g = grad[rows][ok]
+            h = hess[rows][ok]
+            XTHX = Xd.T @ (Xd * h[:, None])
+            XTHX[np.arange(k), np.arange(k)] += cfg.linear_lambda
+            XTg = Xd.T @ g
+            try:
+                coeffs = -np.linalg.solve(XTHX, XTg)
+            except np.linalg.LinAlgError:
+                coeffs = -np.linalg.pinv(XTHX) @ XTg
+            keep = [i for i in range(k)
+                    if abs(coeffs[i]) > 1e-35]     # kZeroThreshold filter
+            tree.leaf_features[leaf] = [feats[i] for i in keep]
+            tree.leaf_features_inner[leaf] = [
+                ds.inner_feature_index(feats[i]) for i in keep]
+            tree.leaf_coeff[leaf] = [float(coeffs[i]) for i in keep]
+            tree.leaf_const[leaf] = float(coeffs[k])
 
     def refit(self, X: np.ndarray, label: np.ndarray,
               weight: Optional[np.ndarray] = None) -> None:

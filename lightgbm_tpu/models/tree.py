@@ -69,6 +69,12 @@ class Tree:
         self.cat_threshold_inner: List[int] = []    # uint32 bitset words (bins)
         self.shrinkage = 1.0
         self.is_linear = is_linear
+        # linear-tree leaf models (ref: tree.h leaf_const_/leaf_coeff_/
+        # leaf_features_; Shi et al. 1802.05640)
+        self.leaf_const = np.zeros(max_leaves, dtype=np.float64)
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(max_leaves)]
+        self.leaf_features: List[List[int]] = [[] for _ in range(max_leaves)]
+        self.leaf_features_inner: List[List[int]] = [[] for _ in range(max_leaves)]
 
     # ------------------------------------------------------------------
     def split(self, leaf: int, inner_feature: int, real_feature: int,
@@ -155,14 +161,20 @@ class Tree:
 
     # ------------------------------------------------------------------
     def apply_shrinkage(self, rate: float) -> None:
-        """(ref: tree.h:187 Shrinkage)."""
+        """(ref: tree.h:187 Shrinkage; linear consts/coeffs scale too)."""
         self.leaf_value[:self.num_leaves] *= rate
         self.internal_value[:max(self.num_leaves - 1, 0)] *= rate
+        if self.is_linear:
+            self.leaf_const[:self.num_leaves] *= rate
+            for i in range(self.num_leaves):
+                self.leaf_coeff[i] = [c * rate for c in self.leaf_coeff[i]]
         self.shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
         """(ref: tree.h:201 AddBias)."""
         self.leaf_value[:self.num_leaves] += val
+        if self.is_linear:
+            self.leaf_const[:self.num_leaves] += val
         self.internal_value[:max(self.num_leaves - 1, 0)] += val
         self.shrinkage = 1.0
 
@@ -225,8 +237,28 @@ class Tree:
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         if self.num_leaves <= 1:
+            if self.is_linear:
+                return np.full(X.shape[0], self.leaf_const[0])
             return np.full(X.shape[0], self.leaf_value[0])
-        return self.leaf_value[self.get_leaf_index(X)]
+        leaf = self.get_leaf_index(X)
+        if not self.is_linear:
+            return self.leaf_value[leaf]
+        # linear leaves: const + coeffs . x; rows with NaN in any of the
+        # leaf's features fall back to leaf_value (ref: tree.cpp:133)
+        out = np.empty(X.shape[0])
+        for l in range(self.num_leaves):
+            rows = np.nonzero(leaf == l)[0]
+            if len(rows) == 0:
+                continue
+            feats = self.leaf_features[l]
+            val = np.full(len(rows), self.leaf_const[l])
+            if feats:
+                sub = X[np.ix_(rows, feats)]
+                nan_rows = np.isnan(sub).any(axis=1)
+                val += sub @ np.asarray(self.leaf_coeff[l])
+                val = np.where(nan_rows, self.leaf_value[l], val)
+            out[rows] = val
+        return out
 
     # ------------------------------------------------------------------
     def to_string(self, index: int) -> str:
@@ -260,6 +292,21 @@ class Tree:
             iarr("cat_boundaries", np.array(self.cat_boundaries), self.num_cat + 1)
             iarr("cat_threshold", np.array(self.cat_threshold), len(self.cat_threshold))
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # ref: tree.cpp:379-399 linear serialization
+            arr("leaf_const", self.leaf_const, nl, high=True)
+            lines.append("num_features=" + " ".join(
+                str(len(self.leaf_coeff[i])) for i in range(nl)))
+            feats_parts = []
+            coef_parts = []
+            for i in range(nl):
+                if self.leaf_coeff[i]:
+                    feats_parts.append(" ".join(
+                        str(f) for f in self.leaf_features[i]))
+                    coef_parts.append(" ".join(
+                        _fmt(c, True) for c in self.leaf_coeff[i]))
+            lines.append("leaf_features=" + " ".join(feats_parts))
+            lines.append("leaf_coeff=" + " ".join(coef_parts))
         lines.append(f"shrinkage={_fmt(self.shrinkage)}")
         lines.append("")
         return "\n".join(lines) + "\n"
@@ -305,6 +352,19 @@ class Tree:
             t.cat_threshold_inner = list(t.cat_threshold)
         t.shrinkage = float(kv.get("shrinkage", "1"))
         t.is_linear = bool(int(kv.get("is_linear", "0")))
+        if t.is_linear:
+            # ref: tree.cpp Tree(const char*) linear block
+            t.leaf_const[:nl] = read_arr("leaf_const", np.float64, nl)
+            nfeat = [int(x) for x in kv.get("num_features", "").split()]
+            feats = [int(x) for x in kv.get("leaf_features", "").split()]
+            coefs = [float(x) for x in kv.get("leaf_coeff", "").split()]
+            pos = 0
+            for i in range(nl):
+                k = nfeat[i] if i < len(nfeat) else 0
+                t.leaf_features[i] = feats[pos:pos + k]
+                t.leaf_features_inner[i] = list(t.leaf_features[i])
+                t.leaf_coeff[i] = coefs[pos:pos + k]
+                pos += k
         return t
 
     def to_json(self, index: int) -> dict:
