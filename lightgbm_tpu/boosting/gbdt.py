@@ -247,13 +247,31 @@ class GBDT:
                         f"{config.monotone_constraints_method} falls back "
                         "to basic on TPU (slack propagation across leaves "
                         "is inherently sequential)")
+        # CEGB (ref: cost_effective_gradient_boosting.hpp IsEnable)
+        has_cegb = (config.cegb_tradeoff < 1.0
+                    or config.cegb_penalty_split > 0.0
+                    or bool(config.cegb_penalty_feature_coupled))
+        if config.cegb_penalty_feature_lazy:
+            log.warning("cegb_penalty_feature_lazy is not supported on TPU "
+                        "(needs a per-(row, feature) usage bitset); "
+                        "ignoring it")
+        coupled = np.zeros(len(nb), np.float32)
+        if config.cegb_penalty_feature_coupled:
+            cp = list(config.cegb_penalty_feature_coupled)
+            if len(cp) != train_data.num_total_features:
+                log.fatal("cegb_penalty_feature_coupled should be the same "
+                          "size as feature number.")
+            for i, f in enumerate(train_data.used_features):
+                coupled[i] = cp[f]
+        self._cegb_used = (jnp.zeros(len(nb), bool) if has_cegb else None)
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(self.f_num_bin),
             missing_type=jnp.asarray(self.f_missing_type),
             default_bin=jnp.asarray(self.f_default_bin),
             penalty=jnp.asarray(penalty),
             is_cat=jnp.asarray(self.f_is_cat),
-            monotone=jnp.asarray(mono))
+            monotone=jnp.asarray(mono),
+            cegb_coupled=jnp.asarray(coupled))
 
         max_b = int(self.f_num_bin.max()) if len(nb) else 1
         # histogram stack memory guard (HistogramPool analogue)
@@ -280,7 +298,10 @@ class GBDT:
                 has_monotone=has_mono,
                 monotone_penalty=config.monotone_penalty,
                 extra_trees=config.extra_trees,
-                extra_seed=config.extra_seed),
+                extra_seed=config.extra_seed,
+                has_cegb=has_cegb,
+                cegb_tradeoff=config.cegb_tradeoff,
+                cegb_penalty_split=config.cegb_penalty_split),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -481,6 +502,15 @@ class GBDT:
                     return jnp.where(sh > 0, out, leaf_value)
                 self._renew_quant_fn = jax.jit(_renew)
 
+        if has_cegb:
+            F_used = len(nb)
+
+            @jax.jit
+            def _cegb_mark(used, split_feature, num_leaves):
+                m = jnp.arange(split_feature.shape[0]) < num_leaves - 1
+                return used.at[jnp.where(m, split_feature, F_used)].set(
+                    True, mode="drop")
+            self._cegb_mark_fn = _cegb_mark
         self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._ones_col_mask = jnp.ones(len(nb), bool)
@@ -673,9 +703,16 @@ class GBDT:
                 else:
                     gq, hq = g_k, h_k
                 with global_timer.scope("GBDT::grow_tree"):
+                    grow_kw = ({"cegb_used": self._cegb_used}
+                               if self._cegb_used is not None else {})
                     arrays, leaf_id = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
-                        self._col_mask(), self.meta, self.grow_params)
+                        self._col_mask(), self.meta, self.grow_params,
+                        **grow_kw)
+                if self._cegb_used is not None:
+                    self._cegb_used = self._cegb_mark_fn(
+                        self._cegb_used, arrays.split_feature,
+                        arrays.num_leaves)
                 with global_timer.scope("GBDT::finalize_tree"):
                     tree = self._finalize_tree(arrays, leaf_id, k,
                                                init_scores[k],

@@ -55,6 +55,7 @@ class FeatureMeta(NamedTuple):
     penalty: jnp.ndarray        # [F] float32 (feature_contri)
     is_cat: jnp.ndarray = None  # [F] bool (None when no categorical)
     monotone: jnp.ndarray = None  # [F] int32 -1/0/+1 (None when unused)
+    cegb_coupled: jnp.ndarray = None  # [F] float32 coupled penalties
 
 
 class GrowParams(NamedTuple):
@@ -125,6 +126,7 @@ class _State(NamedTuple):
     leaf_seg_cnt: jnp.ndarray   # [L] segment lengths incl. bagged-out rows
     leaf_cmin: jnp.ndarray      # [L] monotone min constraint (or [1] dummy)
     leaf_cmax: jnp.ndarray      # [L] monotone max constraint
+    cegb_used: jnp.ndarray      # [F] bool coupled-penalty paid (or [1])
     done: jnp.ndarray           # scalar bool
 
 
@@ -149,7 +151,7 @@ def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
 @functools.partial(jax.jit, static_argnames=("params",))
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               row_mask: jnp.ndarray, col_mask: jnp.ndarray, meta: FeatureMeta,
-              params: GrowParams):
+              params: GrowParams, cegb_used: jnp.ndarray = None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -214,7 +216,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                            meta.num_bin - 3).astype(jnp.int32)
 
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
-                depth=None, rand_tag=0):
+                depth=None, rand_tag=0, used=None):
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
@@ -222,6 +224,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                       mono_penalty=mono_penalty_of(depth))
         if sp.extra_trees:
             kw["rand_bin"] = _rand_bins(rand_tag)
+        if sp.has_cegb:
+            kw["cegb_coupled"] = meta.cegb_coupled
+            kw["cegb_used"] = used
         return find_best_split(hist, meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
                                sum_g, sum_h, cnt, parent_out, sp,
@@ -265,9 +270,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     cnt0 = jnp.sum(row_mask.astype(jnp.int32))
     root_hist = hist_of(ones_mask)
     inf = jnp.asarray(jnp.inf, f32)
+    if cegb_used is None:
+        cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
     root_best = best_of(root_hist, sum_g0, sum_h0, cnt0,
                         jnp.asarray(0.0, f32), -inf, inf,
-                        jnp.asarray(0, jnp.int32), rand_tag=0)
+                        jnp.asarray(0, jnp.int32), rand_tag=0,
+                        used=cegb_used)
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -325,6 +333,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                       f32),
                    leaf_cmax=jnp.full(L if sp.has_monotone else 1, jnp.inf,
                                       f32),
+                   cegb_used=cegb_used,
                    done=jnp.asarray(False))
 
     def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
@@ -513,12 +522,27 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 leaf_cmin, leaf_cmax = st.leaf_cmin, st.leaf_cmax
                 l_min = l_max = r_min = r_max = None
 
+            # CEGB bookkeeping (ref: UpdateLeafBestSplits): the winning
+            # feature's coupled penalty is paid once; other leaves' pending
+            # gains on that feature get the penalty added back
+            if sp.has_cegb:
+                newly_used = ~st.cegb_used[feat]
+                used_vec = st.cegb_used.at[feat].set(True)
+                if meta.cegb_coupled is not None:
+                    refund = jnp.where(
+                        newly_used & (pd.feature == feat)
+                        & (pd.gain > K_MIN_SCORE),
+                        sp.cegb_tradeoff
+                        * meta.cegb_coupled[feat], 0.0)
+                    pd = pd._replace(gain=pd.gain + refund)
+            else:
+                used_vec = st.cegb_used
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
                              pd.left_output[best_leaf], l_min, l_max, depth,
-                             rand_tag=2 * i + 1)
+                             rand_tag=2 * i + 1, used=used_vec)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
-                             depth, rand_tag=2 * i + 2)
+                             depth, rand_tag=2 * i + 2, used=used_vec)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
@@ -530,6 +554,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           order=order, leaf_start=leaf_start,
                           leaf_seg_cnt=leaf_seg_cnt,
                           leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
+                          cegb_used=used_vec,
                           done=st.done)
 
         return jax.lax.cond(proceed, do_split,

@@ -72,7 +72,8 @@ def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
 @functools.partial(jax.jit, static_argnames=("params",))
 def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    row_mask: jnp.ndarray, col_mask: jnp.ndarray,
-                   meta: FeatureMeta, params: GrowParams):
+                   meta: FeatureMeta, params: GrowParams,
+                   cegb_used: jnp.ndarray = None):
     """Grow one tree by waves.  Same contract as grow.grow_tree."""
     from ..ops.split import MISSING_NAN, MISSING_ZERO
 
@@ -124,13 +125,16 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         pass
 
-    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb):
+    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used):
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
                       constraint_max=cmax, mono_penalty=_pen_of(dep))
         if sp.extra_trees:
             kw["rand_bin"] = rb
+        if sp.has_cegb:
+            kw["cegb_coupled"] = meta.cegb_coupled
+            kw["cegb_used"] = used
         return find_best_split(
             h, meta.num_bin, meta.missing_type, meta.default_bin,
             meta.penalty, col_mask, sg, sh, c, po, sp,
@@ -141,7 +145,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if sp.has_monotone else None,
                                 0 if sp.has_monotone else None,
                                 0 if sp.has_monotone else None,
-                                0 if sp.extra_trees else None))
+                                0 if sp.extra_trees else None,
+                                None))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
@@ -182,7 +187,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def wave_body(state, NLp):
         """One wave with a static slot bound NLp >= current num_leaves."""
         (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
-         leaf_cmin, leaf_cmax, _) = state
+         leaf_cmin, leaf_cmax, used_vec, _) = state
         NL = tree.num_leaves
 
         # 1. all leaves' histograms + exact per-slot counts in one pass
@@ -195,7 +200,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                       tree.leaf_depth[:NLp]) if sp.has_monotone
                      else (None, None, None))
         best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                       counts, leaf_out[:NLp], *mono_args, rb)
+                       counts, leaf_out[:NLp], *mono_args, rb, used_vec)
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget
@@ -336,12 +341,22 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             go_left = jnp.where(isc_r, cat_left, go_left)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
 
+        if sp.has_cegb:
+            # all of this wave's winning features become used (coupled
+            # penalties within one wave are charged per splitting leaf —
+            # a wave-batching deviation from the reference's per-split
+            # accounting, which refunds later leaves in the same level)
+            used_vec = used_vec.at[jnp.where(split_sel, best.feature,
+                                             num_features)].set(
+                True, mode="drop")
         cont = (n_split > 0) & (tree.num_leaves < L)
         return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
-                leaf_cmin, leaf_cmax, cont)
+                leaf_cmin, leaf_cmax, used_vec, cont)
 
+    if cegb_used is None:
+        cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
     state = (tree, jnp.zeros(n, i32), leaf_sum_g0, leaf_sum_h0, leaf_out0,
-             leaf_cmin0, leaf_cmax0, jnp.asarray(L > 1))
+             leaf_cmin0, leaf_cmax0, cegb_used, jnp.asarray(L > 1))
     num_waves = max(1, math.ceil(math.log2(L))) if L > 1 else 0
     for k in range(num_waves):
         NLp = wave_slot_pad(min(1 << k, L))

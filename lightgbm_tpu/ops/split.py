@@ -76,6 +76,13 @@ class SplitParams(NamedTuple):
     # instead of the full sweep; extra_seed seeds the per-scan draw
     extra_trees: bool = False
     extra_seed: int = 6
+    # cost-effective gradient boosting (ref:
+    # cost_effective_gradient_boosting.hpp:79 DeltaGain): per-feature gain
+    # penalty = tradeoff * (penalty_split * num_data_in_leaf
+    #                       + coupled_penalty[f] * not_yet_used[f])
+    has_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
     max_cat_threshold: int = 32
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
@@ -271,6 +278,8 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     params: SplitParams,
                     is_cat_feature: jnp.ndarray = None,
                     rand_bin: jnp.ndarray = None,
+                    cegb_coupled: jnp.ndarray = None,
+                    cegb_used: jnp.ndarray = None,
                     monotone: jnp.ndarray = None,
                     constraint_min: jnp.ndarray = None,
                     constraint_max: jnp.ndarray = None,
@@ -426,6 +435,14 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     # feature penalty + column sampling, then pick the best feature
     # (gain tie -> smaller index, matching SplitInfo::operator>)
     shifted = (best_gain_f - min_gain_shift) * feature_penalty
+    if params.has_cegb:
+        # ref: serial_tree_learner.cpp:983 new_split.gain -= DeltaGain(...)
+        delta = params.cegb_tradeoff * (
+            params.cegb_penalty_split * num_data.astype(f32))
+        if cegb_coupled is not None:
+            delta = delta + params.cegb_tradeoff * jnp.where(
+                cegb_used, 0.0, cegb_coupled)
+        shifted = shifted - delta
     if params.has_monotone and params.monotone_penalty > 0:
         # depth-based penalty on monotone features' gains
         # (serial_tree_learner.cpp:987-991)

@@ -1,0 +1,66 @@
+"""Cost-effective gradient boosting (ref:
+src/treelearner/cost_effective_gradient_boosting.hpp: DeltaGain =
+tradeoff * (penalty_split * num_data_in_leaf + coupled[f] if unused))."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=3000, seed=10):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4)
+    y = (X[:, 0] + 0.9 * X[:, 1] + 0.1 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def test_penalty_split_shrinks_trees():
+    """A per-split penalty proportional to leaf size stops splitting
+    earlier: fewer total leaves than the unpenalized model."""
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 63, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    b1 = lgb.train({**base, "cegb_penalty_split": 0.01},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    b0._gbdt._sync_model()
+    b1._gbdt._sync_model()
+    leaves0 = sum(t.num_leaves for t in b0._gbdt.models_)
+    leaves1 = sum(t.num_leaves for t in b1._gbdt.models_)
+    assert leaves1 < leaves0, (leaves1, leaves0)
+    assert leaves1 > len(b1._gbdt.models_)  # still splits at the root
+
+
+def test_coupled_penalty_concentrates_features():
+    """Expensive coupled features are avoided unless they pay for
+    themselves; the model concentrates on the cheap ones."""
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    # make features 2,3 (noise) expensive and 0,1 free
+    b = lgb.train({**base,
+                   "cegb_penalty_feature_coupled": [0.0, 0.0, 1e5, 1e5]},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = b._gbdt.feature_importance("split")
+    assert imp[2] == 0 and imp[3] == 0, imp
+    assert imp[0] > 0 and imp[1] > 0, imp
+    # without penalties the noise features do appear occasionally
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=10)
+    imp0 = b0._gbdt.feature_importance("split")
+    assert imp0[2] + imp0[3] > 0, imp0
+
+
+def test_coupled_penalty_paid_once():
+    """Once a coupled feature is bought, later trees use it freely: with a
+    penalty it can just afford, it appears in many trees."""
+    rng = np.random.RandomState(3)
+    n = 2000
+    X = rng.randn(n, 2)
+    y = X[:, 0] * 2 + 0.05 * rng.randn(n)   # only feature 0 matters
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "cegb_penalty_feature_coupled": [1.0, 1.0]},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    imp = b._gbdt.feature_importance("split")
+    assert imp[0] >= 8, imp  # used across trees after first purchase
