@@ -169,12 +169,14 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
         @pl.when((bg == 0) & (g == 0) & (pl.program_id(2) == 0))
         def _init_cnt():
             cnt_ref[...] = jnp.zeros_like(cnt_ref)
-        rows = rows_ref[...].astype(jnp.int32)           # [Fg, Rt]
+        # offset the SMALL [Fg, Rt] rows instead of the big [Fg, Bg, Rt]
+        # iota: the one-hot construction is the per-wave VPU floor, so
+        # every elementwise pass over the big shape counts
+        rows = rows_ref[...].astype(jnp.int32) - bg * Bg  # [Fg, Rt]
         slot = slot_ref[...].astype(jnp.int32)           # [Rt, 1]
         gh = gh_ref[...]                                 # [Rt, C+1]
         Rt = rows.shape[1]
-        biota = (jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
-                 + bg * Bg)
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
         oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
         oh2 = oh.reshape(Fg * Bg, Rt)
         S = out_ref.shape[-1] // (C * NLg)
@@ -268,7 +270,8 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     NLp = wave_slot_pad(num_slots)
     NLg = min(NLp, 128)
     Bp = max(8, (max_bin + 7) // 8 * 8)
-    Bg = min(Bp, 128)
+    # one bin group when it fits: rows are then streamed once per wave
+    Bg = min(Bp, 256)
     if Bp % Bg != 0:
         Bp = (Bp + Bg - 1) // Bg * Bg
     if n % row_tile != 0:
