@@ -146,9 +146,10 @@ class GBDT:
         n_mesh = min(want, ndev)
         if tl == "feature":
             # GSPMD needs the sharded axis size divisible by the mesh: use
-            # the largest divisor of F (the reference instead hand-balances
-            # unequal feature subsets, feature_parallel_tree_learner.cpp:30)
-            F = len(self.train_data.used_features)
+            # the largest divisor of the device column count (the reference
+            # instead hand-balances unequal feature subsets,
+            # feature_parallel_tree_learner.cpp:30)
+            F = self._n_device_cols
             while n_mesh > 1 and F % n_mesh != 0:
                 n_mesh -= 1
         if n_mesh <= 1:
@@ -203,7 +204,24 @@ class GBDT:
         n = train_data.num_data
         self.n_pad = (n + _PAD - 1) // _PAD * _PAD
         binned = train_data.binned
-        dtype = np.uint8 if train_data.max_num_bin <= 256 else np.int32
+        # EFB: bundle exclusive sparse features into shared device columns
+        # (ref: feature_group.h; io/bundle.py).  The bundle plan is purely
+        # a device-layout optimization — host paths (prediction, leaf ids,
+        # model IO) keep per-feature bins.
+        self.bundle_plan = None
+        if config.enable_bundle and train_data.num_features > 1:
+            from ..io.bundle import build_bundled, plan_bundles
+            plan = plan_bundles(binned, train_data.bin_mappers,
+                                train_data.used_features,
+                                max_conflict_rate=config.max_conflict_rate)
+            if plan.effective:
+                self.bundle_plan = plan
+                binned = build_bundled(binned, plan)
+                log.info(f"EFB bundled {len(plan.group_idx)} features into "
+                         f"{plan.num_groups} columns")
+        dtype = np.uint8 if (binned.max() if self.bundle_plan else
+                             train_data.max_num_bin - 1) <= 255 else np.int32
+        self._n_device_cols = binned.shape[0]
         self.mesh = self._make_training_mesh(config)
         self.binned_dev = self._put_by_row(
             _pad_rows(binned.astype(dtype), self.n_pad), axis=1,
@@ -264,6 +282,7 @@ class GBDT:
             for i, f in enumerate(train_data.used_features):
                 coupled[i] = cp[f]
         self._cegb_used = (jnp.zeros(len(nb), bool) if has_cegb else None)
+        bp = self.bundle_plan
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(self.f_num_bin),
             missing_type=jnp.asarray(self.f_missing_type),
@@ -271,7 +290,11 @@ class GBDT:
             penalty=jnp.asarray(penalty),
             is_cat=jnp.asarray(self.f_is_cat),
             monotone=jnp.asarray(mono),
-            cegb_coupled=jnp.asarray(coupled))
+            cegb_coupled=jnp.asarray(coupled),
+            group=None if bp is None else jnp.asarray(bp.group_idx),
+            offset=None if bp is None else jnp.asarray(bp.offsets),
+            zero_bin=None if bp is None else jnp.asarray(bp.zero_bin),
+            in_bundle=None if bp is None else jnp.asarray(bp.in_bundle))
 
         max_b = int(self.f_num_bin.max()) if len(nb) else 1
         # histogram stack memory guard (HistogramPool analogue)
@@ -302,6 +325,9 @@ class GBDT:
                 has_cegb=has_cegb,
                 cegb_tradeoff=config.cegb_tradeoff,
                 cegb_penalty_split=config.cegb_penalty_split),
+            has_bundles=bp is not None,
+            group_max_bin=(0 if bp is None
+                           else int(bp.group_num_bin.max())),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's

@@ -56,6 +56,12 @@ class FeatureMeta(NamedTuple):
     is_cat: jnp.ndarray = None  # [F] bool (None when no categorical)
     monotone: jnp.ndarray = None  # [F] int32 -1/0/+1 (None when unused)
     cegb_coupled: jnp.ndarray = None  # [F] float32 coupled penalties
+    # EFB (ref: feature_group.h): feature -> bundle column, code offset,
+    # default (zero) bin, membership flag (None/unused when not bundling)
+    group: jnp.ndarray = None       # [F] int32 bundle column index
+    offset: jnp.ndarray = None      # [F] int32 code offset (0 singleton)
+    zero_bin: jnp.ndarray = None    # [F] int32 default bin
+    in_bundle: jnp.ndarray = None   # [F] bool
 
 
 class GrowParams(NamedTuple):
@@ -72,6 +78,39 @@ class GrowParams(NamedTuple):
     # full-scan engine (every split rescans all n rows; needed under row
     # sharding, where rows may not be gathered by global index).
     compact_min: int = 4096
+    # EFB: binned is [F_groups, n] bundle codes; histograms are built in
+    # group space (group_max_bin bins) and converted back to per-feature
+    # space for the scan (gather + FixHistogram by subtraction)
+    has_bundles: bool = False
+    group_max_bin: int = 0
+
+
+def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
+                            B: int, hist_B: int, has_bundles: bool):
+    """[F_groups, B', 2] group hist -> [F, B, 2] per-feature hist under
+    EFB: each member's code range is sliced out and its default bin is
+    recovered by subtraction from the leaf totals
+    (ref: dataset.h:759 FixHistogram).  No-op without bundles."""
+    if not has_bundles:
+        return hist_g
+    cols = meta.offset[:, None] + jnp.arange(B, dtype=jnp.int32)[None, :]
+    valid = ((jnp.arange(B, dtype=jnp.int32)[None, :]
+              < meta.num_bin[:, None])
+             & (cols < hist_B))
+    hist_f = hist_g[meta.group[:, None],
+                    jnp.clip(cols, 0, hist_B - 1)]          # [F, B, 2]
+    hist_f = hist_f * valid[:, :, None]
+    zb = meta.zero_bin
+    nonzb = (jnp.arange(B, dtype=jnp.int32)[None, :] != zb[:, None])
+    rest = jnp.sum(hist_f * nonzb[:, :, None], axis=1)      # [F, 2]
+    fix = jnp.stack([sum_g, sum_h], -1)[None, :] - rest     # [F, 2]
+    fixed = jnp.take_along_axis(
+        hist_f, zb[:, None, None].repeat(2, 2), 1)
+    new_zb = jnp.where(meta.in_bundle[:, None], fix, fixed[:, 0, :])
+    hist_f = jnp.where(
+        (jnp.arange(B)[None, :, None] == zb[:, None, None]),
+        new_zb[:, None, :], hist_f)
+    return hist_f
 
 
 class TreeArrays(NamedTuple):
@@ -164,9 +203,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     Returns: (TreeArrays, leaf_id [n] int32)
     """
-    num_features, n = binned.shape
+    if params.has_bundles:
+        num_features = meta.num_bin.shape[0]
+    else:
+        num_features = binned.shape[0]
+    n = binned.shape[1]
     L = params.num_leaves
     B = params.max_bin
+    hist_B = params.group_max_bin if params.has_bundles else B
     sp = params.split
     f32 = jnp.float32
 
@@ -178,19 +222,28 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     use_pallas = params.hist_method == "pallas"
 
+    def to_feature_hist(hist_g, sum_g, sum_h):
+        return bundle_hist_to_features(hist_g, sum_g, sum_h, meta, B,
+                                       hist_B, params.has_bundles)
+
     def hist_of(member_mask):
+        """Group-space histogram [F_groups, B', 2]; converted to feature
+        space only at the scan (best_of), where the leaf sums needed by
+        FixHistogram are in hand.  The per-leaf stack and the smaller-
+        child subtraction stay in group space (subtraction is linear, so
+        group-space subtraction == feature-space subtraction)."""
         if use_pallas:
             return build_histogram_rows_pallas(binned.T, gh, member_mask,
-                                               max_bin=B)
-        return build_histogram(binned, gh, member_mask, max_bin=B,
+                                               max_bin=hist_B)
+        return build_histogram(binned, gh, member_mask, max_bin=hist_B,
                                method=params.hist_method)
 
     def hist_of_rows(rows, gh_sub, member_mask):
-        """Histogram over row-major gathered rows [S, F]."""
+        """Histogram over row-major gathered rows [S, F_groups]."""
         if use_pallas:
             return build_histogram_rows_pallas(rows, gh_sub, member_mask,
-                                               max_bin=B)
-        return build_histogram(rows.T, gh_sub, member_mask, max_bin=B,
+                                               max_bin=hist_B)
+        return build_histogram(rows.T, gh_sub, member_mask, max_bin=hist_B,
                                method=params.hist_method)
 
     def mono_penalty_of(depth):
@@ -227,7 +280,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if sp.has_cegb:
             kw["cegb_coupled"] = meta.cegb_coupled
             kw["cegb_used"] = used
-        return find_best_split(hist, meta.num_bin, meta.missing_type,
+        return find_best_split(to_feature_hist(hist, sum_g, sum_h),
+                               meta.num_bin, meta.missing_type,
                                meta.default_bin, meta.penalty, col_mask,
                                sum_g, sum_h, cnt, parent_out, sp,
                                is_cat_feature=meta.is_cat, **kw)
@@ -253,7 +307,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def go_left_of(fbins, feat, dleft, thr, isc, bitset):
         """Partition rule in bin space (ref: dense_bin.hpp:346-366
         SplitInner; categorical: bin in bitset -> left, ref: tree.h:372
-        CategoricalDecision with the NaN/other bin 0 never in the set)."""
+        CategoricalDecision with the NaN/other bin 0 never in the set).
+        Under EFB, fbins are BUNDLE codes: decode the feature's range,
+        anything else means the feature sits at its default bin."""
+        if params.has_bundles:
+            local = fbins - meta.offset[feat]
+            fbins = jnp.where((local >= 0) & (local < meta.num_bin[feat]),
+                              local, meta.zero_bin[feat])
         mt_f = meta.missing_type[feat]
         is_missing = (((mt_f == MISSING_NAN) & (fbins == meta.num_bin[feat] - 1))
                       | ((mt_f == MISSING_ZERO) & (fbins == meta.default_bin[feat])))
@@ -309,7 +369,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     pending = _pending_set(pending, 0, root_best)
 
     if params.use_hist_stack:
-        hist_stack = jnp.zeros((L, num_features, B, 2), f32).at[0].set(root_hist)
+        FH = binned.shape[0]
+        hist_stack = jnp.zeros((L, FH, hist_B, 2), f32).at[0].set(root_hist)
     else:
         hist_stack = jnp.zeros((1, 1, 1, 2), f32)
 
@@ -351,8 +412,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 order, leaf_id = operand
                 idxs = jax.lax.dynamic_slice(order, (start,), (S,))
                 valid = jnp.arange(S, dtype=jnp.int32) < seg_cnt
-                rows = jnp.take(binned_rows, idxs, axis=0)     # [S, F]
-                fbins = jnp.take(rows, feat, axis=1).astype(jnp.int32)
+                rows = jnp.take(binned_rows, idxs, axis=0)     # [S, F']
+                col = meta.group[feat] if params.has_bundles else feat
+                fbins = jnp.take(rows, col, axis=1).astype(jnp.int32)
                 gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
                 lm = gl & valid
                 rm = (~gl) & valid
@@ -366,7 +428,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     small_hist = hist_of_rows(rows, gh_sub,
                                               small_m.astype(f32))
                 else:  # children rebuilt from scratch downstream
-                    small_hist = jnp.zeros((num_features, B, 2), f32)
+                    small_hist = jnp.zeros((binned.shape[0], hist_B, 2),
+                                           f32)
                 # stable in-place partition of the segment window; slots
                 # beyond seg_cnt keep their original values
                 cl_seg = jnp.sum(lm.astype(jnp.int32))
@@ -398,7 +461,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def mask_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
                       isc, bitset):
         """Masked engine: recolor by scanning all rows (data-parallel safe)."""
-        fbins = jnp.take(binned, feat, axis=0).astype(jnp.int32)
+        col = meta.group[feat] if params.has_bundles else feat
+        fbins = jnp.take(binned, col, axis=0).astype(jnp.int32)
         gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
         in_leaf = st.leaf_id == best_leaf
         leaf_id = jnp.where(in_leaf & ~gl, new_leaf, st.leaf_id)
@@ -411,7 +475,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             small_mask = jnp.where(smaller_is_left, lmaskf, rmaskf)
             small_hist = hist_of(small_mask)
         else:  # children rebuilt from scratch downstream
-            small_hist = jnp.zeros((num_features, B, 2), f32)
+            small_hist = jnp.zeros((binned.shape[0], hist_B, 2), f32)
         return (st.order, leaf_id, st.leaf_start, st.leaf_seg_cnt, small_hist,
                 cnt_l, cnt_r, smaller_is_left)
 

@@ -49,7 +49,8 @@ import jax.numpy as jnp
 
 from ..ops.histogram import build_histogram_wave, wave_slot_pad
 from ..ops.split import K_MIN_SCORE, cat_bitset_words, find_best_split
-from .grow import FeatureMeta, GrowParams, TreeArrays
+from .grow import (FeatureMeta, GrowParams, TreeArrays,
+                   bundle_hist_to_features)
 
 
 def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
@@ -77,9 +78,14 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     """Grow one tree by waves.  Same contract as grow.grow_tree."""
     from ..ops.split import MISSING_NAN, MISSING_ZERO
 
-    num_features, n = binned.shape
+    if params.has_bundles:
+        num_features = meta.num_bin.shape[0]
+    else:
+        num_features = binned.shape[0]
+    n = binned.shape[1]
     L = params.num_leaves
     B = params.max_bin
+    hist_B = params.group_max_bin if params.has_bundles else B
     sp = params.split
     f32 = jnp.float32
     i32 = jnp.int32
@@ -95,10 +101,11 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     use_pallas = params.hist_method == "pallas"
 
     def hists_of(leaf_id, num_slots):
+        """Group-space histograms; converted per slot at the scan."""
         if use_pallas:
             return build_histogram_wave(binned, leaf_id, gh,
-                                        max_bin=B, num_slots=num_slots)
-        return _hist_wave_xla(binned, leaf_id, gh, max_bin=B,
+                                        max_bin=hist_B, num_slots=num_slots)
+        return _hist_wave_xla(binned, leaf_id, gh, max_bin=hist_B,
                               num_slots=num_slots)
 
     if sp.extra_trees:
@@ -126,6 +133,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         pass
 
     def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used):
+        h = bundle_hist_to_features(h, sg, sh, meta, B, hist_B,
+                                    params.has_bundles)
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
@@ -310,12 +319,17 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 jnp.take(meta.missing_type, best.feature),
                 jnp.take(meta.default_bin, best.feature),
                 jnp.take(meta.num_bin, best.feature)]
+        if params.has_bundles:
+            cols += [jnp.take(meta.group, best.feature),
+                     jnp.take(meta.offset, best.feature),
+                     jnp.take(meta.zero_bin, best.feature)]
+        n_base = len(cols)
         if sp.has_categorical:
             packed = jnp.concatenate(
                 [jnp.stack(cols + [best.is_cat.astype(i32)], axis=1),
-                 best.cat_bitset], axis=1)                   # [NLp, 9+W]
+                 best.cat_bitset], axis=1)
         else:
-            packed = jnp.stack(cols, axis=1)                 # [NLp, 8]
+            packed = jnp.stack(cols, axis=1)
         prow = jnp.take(packed, leaf_id, axis=0)
         sel_r = prow[:, 0] > 0
         feat_r = prow[:, 1]
@@ -325,18 +339,29 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         mt_r = prow[:, 5]
         db_r = prow[:, 6]
         nb_r = prow[:, 7]
-        # per-row bin of the row's split feature (one-hot select over F)
+        if params.has_bundles:
+            grp_r = prow[:, 8]
+            off_r = prow[:, 9]
+            zb_r = prow[:, 10]
+            col_r = grp_r
+        else:
+            col_r = feat_r
+        # per-row bin of the row's split column (one-hot select over F')
         fbin = jnp.sum(jnp.where(
-            feat_r[None, :] == jnp.arange(num_features, dtype=i32)[:, None],
+            col_r[None, :] == jnp.arange(binned.shape[0],
+                                         dtype=i32)[:, None],
             binned.astype(i32), 0), axis=0)
+        if params.has_bundles:
+            local = fbin - off_r
+            fbin = jnp.where((local >= 0) & (local < nb_r), local, zb_r)
         is_missing = (((mt_r == MISSING_NAN) & (fbin == nb_r - 1))
                       | ((mt_r == MISSING_ZERO) & (fbin == db_r)))
         go_left = jnp.where(is_missing, dleft_r, fbin <= thr_r)
         if sp.has_categorical:
-            isc_r = prow[:, 8] > 0
+            isc_r = prow[:, n_base] > 0
             word_r = jnp.take_along_axis(
-                prow[:, 9:], jnp.clip(fbin // 32, 0, W - 1)[:, None],
-                1)[:, 0]
+                prow[:, n_base + 1:],
+                jnp.clip(fbin // 32, 0, W - 1)[:, None], 1)[:, 0]
             cat_left = ((word_r >> (fbin % 32)) & 1) > 0
             go_left = jnp.where(isc_r, cat_left, go_left)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
