@@ -343,6 +343,41 @@ class GBDT:
             from ..parallel import grow_params_for_mesh
             self.grow_params = grow_params_for_mesh(
                 self.grow_params)._replace(hist_method="segment")
+        # forced splits (ref: serial_tree_learner.cpp:614 ForceSplits):
+        # parse the BFS JSON into static (leaf, inner_feature, bin) tuples
+        # using our split numbering (left child keeps the leaf index,
+        # right child becomes leaf step+1)
+        if config.forcedsplits_filename:
+            import json as _json
+            from collections import deque
+            with open(config.forcedsplits_filename) as f:
+                forced_json = _json.load(f)
+            inner_of = {f: i for i, f in enumerate(train_data.used_features)}
+            forced = []
+            queue = deque([(forced_json, 0)])
+            while queue and len(forced) < config.num_leaves - 1:
+                node, leaf = queue.popleft()
+                if not node or "feature" not in node:
+                    continue
+                real_f = int(node["feature"])
+                if real_f not in inner_of:
+                    log.warning(f"forced split feature {real_f} unused; "
+                                "skipping subtree")
+                    continue
+                fi = inner_of[real_f]
+                mapper = train_data.bin_mappers[real_f]
+                thr_bin = mapper.value_to_bin(float(node["threshold"]))
+                new_leaf = len(forced) + 1
+                forced.append((leaf, fi, int(thr_bin)))
+                if "left" in node and node["left"]:
+                    queue.append((node["left"], leaf))
+                if "right" in node and node["right"]:
+                    queue.append((node["right"], new_leaf))
+            self.grow_params = self.grow_params._replace(
+                forced_splits=tuple(forced))
+            if not self.grow_params.use_hist_stack:
+                log.fatal("forced splits need the per-leaf histogram stack; "
+                          "raise histogram_pool_size")
         # growth engine: wave (level-batched; one MXU histogram sweep per
         # round with leaf slots as the matmul's output columns) vs strict
         # leaf-wise (partitioned segments; the reference-parity order)
@@ -351,6 +386,10 @@ class GBDT:
         if strategy not in ("auto", "wave", "leafwise"):
             log.fatal(f"Unknown tpu_growth_strategy {strategy!r}; "
                       "expected auto, wave, or leafwise")
+        if self.grow_params.forced_splits:
+            if strategy == "wave":
+                log.warning("forced splits use the leaf-wise engine")
+            strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"
                         and config.num_leaves >= 8

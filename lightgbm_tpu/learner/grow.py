@@ -83,6 +83,10 @@ class GrowParams(NamedTuple):
     # space for the scan (gather + FixHistogram by subtraction)
     has_bundles: bool = False
     group_max_bin: int = 0
+    # forced splits (ref: serial_tree_learner.cpp:614 ForceSplits):
+    # static BFS-ordered (leaf, inner_feature, threshold_bin) tuples
+    # applied before best-gain growth; needs use_hist_stack
+    forced_splits: tuple = ()
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -479,15 +483,22 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return (st.order, leaf_id, st.leaf_start, st.leaf_seg_cnt, small_hist,
                 cnt_l, cnt_r, smaller_is_left)
 
-    def body(i, st: _State):
+    def body(i, st: _State, forced_leaf=None):
         # leaf selection (ref: serial_tree_learner.cpp:219 ArgMax over leaves);
         # max_depth gates children depth (ref: serial_tree_learner BeforeFindBestSplit)
         sel_gain = st.pending.gain
         if params.max_depth > 0:
             sel_gain = jnp.where(st.tree.leaf_depth < params.max_depth,
                                  sel_gain, K_MIN_SCORE)
-        best_leaf = jnp.argmax(sel_gain).astype(jnp.int32)
-        proceed = jnp.logical_and(~st.done, sel_gain[best_leaf] > 0.0)
+        if forced_leaf is not None:
+            # forced splits apply regardless of gain rank (ForceSplits)
+            best_leaf = jnp.asarray(forced_leaf, jnp.int32)
+            proceed = jnp.logical_and(~st.done,
+                                      st.pending.gain[best_leaf]
+                                      > K_MIN_SCORE)
+        else:
+            best_leaf = jnp.argmax(sel_gain).astype(jnp.int32)
+            proceed = jnp.logical_and(~st.done, sel_gain[best_leaf] > 0.0)
 
         def do_split(st: _State) -> _State:
             node = i                      # node index == step (num_leaves-1)
@@ -624,8 +635,58 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return jax.lax.cond(proceed, do_split,
                             lambda s: s._replace(done=jnp.asarray(True)), st)
 
-    if L > 1:
-        state = jax.lax.fori_loop(0, L - 1, body, state)
+    def forced_pending(st: _State, leaf, feat, thr):
+        """Pending entry for a forced (feature, threshold) split of
+        `leaf`, gathered from its histogram (ref: feature_histogram
+        GatherInfoForThreshold).  Missing values join the right side."""
+        sum_g = st.leaf_sum_g[leaf]
+        sum_h = st.leaf_sum_h[leaf] + 2 * 1e-15
+        hist = bundle_hist_to_features(
+            st.hist_stack[leaf], sum_g, st.leaf_sum_h[leaf], meta, B,
+            hist_B, params.has_bundles)
+        nleaf = st.tree.leaf_count[leaf].astype(f32)
+        cnt_factor = nleaf / sum_h
+        bins = jnp.arange(B, dtype=jnp.int32)
+        nb = meta.num_bin[feat]
+        is_na = ((meta.missing_type[feat] == MISSING_NAN)
+                 & (bins == nb - 1))
+        take = (bins <= thr) & (bins < nb) & ~is_na
+        hf = hist[feat]
+        lg = jnp.sum(jnp.where(take, hf[:, 0], 0.0))
+        lh_raw = jnp.sum(jnp.where(take, hf[:, 1], 0.0))
+        lh = lh_raw + 1e-15
+        lc = jnp.round(lh_raw * cnt_factor).astype(jnp.int32)
+        rg = sum_g - lg
+        rh = sum_h - lh
+        rc = st.tree.leaf_count[leaf].astype(jnp.int32) - lc
+        po = st.pending.left_output[leaf] * 0.0
+        from ..ops.split import leaf_gain, leaf_output
+        gain = (leaf_gain(lg, lh, lc.astype(f32), po, sp)
+                + leaf_gain(rg, rh, rc.astype(f32), po, sp))
+        valid = (lc > 0) & (rc > 0)
+        res = SplitResult(
+            gain=jnp.where(valid, gain, K_MIN_SCORE),
+            feature=jnp.asarray(feat, jnp.int32),
+            threshold=jnp.asarray(thr, jnp.int32),
+            default_left=jnp.asarray(False),
+            left_sum_gradient=lg, left_sum_hessian=lh - 1e-15,
+            left_count=lc,
+            left_output=leaf_output(lg, lh, lc.astype(f32), po, sp),
+            right_sum_gradient=rg, right_sum_hessian=rh - 1e-15,
+            right_count=rc,
+            right_output=leaf_output(rg, rh, rc.astype(f32), po, sp),
+            is_cat=jnp.asarray(False),
+            cat_bitset=jnp.zeros(cat_bitset_words(B), jnp.int32))
+        return st._replace(pending=_pending_set(st.pending, leaf, res))
+
+    KF = len(params.forced_splits)
+    for k, (fleaf, ffeat, fthr) in enumerate(params.forced_splits):
+        if k >= L - 1:
+            break
+        state = forced_pending(state, fleaf, ffeat, fthr)
+        state = body(k, state, forced_leaf=fleaf)
+    if L > 1 and KF < L - 1:
+        state = jax.lax.fori_loop(min(KF, L - 1), L - 1, body, state)
     return state.tree, state.leaf_id
 
 

@@ -1,0 +1,56 @@
+"""Forced splits (ref: serial_tree_learner.cpp:614 ForceSplits;
+examples/binary_classification/forced_splits.json format)."""
+
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train_with_forced(tmp_path, forced, n=2000, rounds=2, leaves=8):
+    rng = np.random.RandomState(4)
+    X = rng.rand(n, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n)
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(forced))
+    b = lgb.train({"objective": "regression", "num_leaves": leaves,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "forcedsplits_filename": str(path)},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b._gbdt._sync_model()
+    return b
+
+
+def test_root_split_is_forced(tmp_path):
+    b = _train_with_forced(tmp_path,
+                           {"feature": 2, "threshold": 0.5})
+    for t in b._gbdt.models_:
+        assert t.split_feature[0] == 2          # noise feature forced
+        assert abs(t.threshold[0] - 0.5) < 0.02
+
+
+def test_nested_forced_splits(tmp_path):
+    forced = {"feature": 2, "threshold": 0.5,
+              "left": {"feature": 1, "threshold": 0.25},
+              "right": {"feature": 1, "threshold": 0.75}}
+    b = _train_with_forced(tmp_path, forced)
+    t = b._gbdt.models_[0]
+    assert t.split_feature[0] == 2
+    # node 1 splits the LEFT child (leaf 0), node 2 the RIGHT (leaf 1)
+    assert t.split_feature[1] == 1 and t.split_feature[2] == 1
+    assert t.left_child[0] == 1 and t.right_child[0] == 2
+    assert abs(t.threshold[1] - 0.25) < 0.02
+    assert abs(t.threshold[2] - 0.75) < 0.02
+
+
+def test_growth_continues_after_forced(tmp_path):
+    b = _train_with_forced(tmp_path, {"feature": 2, "threshold": 0.5},
+                           leaves=16)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 16
+    # the model still learns the real signal after the forced noise split
+    rng = np.random.RandomState(4)
+    X = rng.rand(2000, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(2000)
+    assert np.corrcoef(b.predict(X), y)[0, 1] > 0.8
