@@ -386,9 +386,26 @@ class GBDT:
         if strategy not in ("auto", "wave", "leafwise"):
             log.fatal(f"Unknown tpu_growth_strategy {strategy!r}; "
                       "expected auto, wave, or leafwise")
-        if self.grow_params.forced_splits:
+        # interaction constraints (ref: config.h:585; col_sampler.hpp:91):
+        # "[0,1,2],[2,3]" -> static inner-index sets
+        if config.interaction_constraints:
+            import re as _re
+            inner_of = {f: i for i, f in enumerate(train_data.used_features)}
+            sets = []
+            for grp in _re.findall(r"\[([^\]]*)\]",
+                                   config.interaction_constraints):
+                idxs = tuple(sorted(inner_of[int(tok)]
+                                    for tok in grp.split(",")
+                                    if tok.strip() != ""
+                                    and int(tok) in inner_of))
+                if idxs:
+                    sets.append(idxs)
+            self.grow_params = self.grow_params._replace(
+                interaction_sets=tuple(sets))
+        if self.grow_params.forced_splits or self.grow_params.interaction_sets:
             if strategy == "wave":
-                log.warning("forced splits use the leaf-wise engine")
+                log.warning("forced splits / interaction constraints use "
+                            "the leaf-wise engine")
             strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"

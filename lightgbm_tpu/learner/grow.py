@@ -87,6 +87,10 @@ class GrowParams(NamedTuple):
     # static BFS-ordered (leaf, inner_feature, threshold_bin) tuples
     # applied before best-gain growth; needs use_hist_stack
     forced_splits: tuple = ()
+    # interaction constraints (ref: col_sampler.hpp:91 GetByNode): static
+    # tuple of tuples of inner feature indices; a leaf may split only on
+    # its branch features plus sets containing the whole branch
+    interaction_sets: tuple = ()
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -170,6 +174,7 @@ class _State(NamedTuple):
     leaf_cmin: jnp.ndarray      # [L] monotone min constraint (or [1] dummy)
     leaf_cmax: jnp.ndarray      # [L] monotone max constraint
     cegb_used: jnp.ndarray      # [F] bool coupled-penalty paid (or [1])
+    leaf_branch: jnp.ndarray    # [L, F] branch features (or [1, 1])
     done: jnp.ndarray           # scalar bool
 
 
@@ -260,6 +265,20 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                    1.0 - pen / jnp.exp2(d) + eps,
                                    1.0 - jnp.exp2(pen - 1.0 - d) + eps))
 
+    if params.interaction_sets:
+        _iset_masks = [
+            jnp.zeros(num_features, bool).at[jnp.asarray(S, jnp.int32)]
+            .set(True) for S in params.interaction_sets]
+
+        def allowed_of(branch):
+            """[F] branch mask -> [F] allowed mask
+            (ref: col_sampler.hpp:91 GetByNode)."""
+            allow = branch
+            for Sm in _iset_masks:
+                ok = ~jnp.any(branch & ~Sm)
+                allow = allow | (Sm & ok)
+            return allow
+
     if sp.extra_trees:
         _extra_key = jax.random.PRNGKey(sp.extra_seed)
 
@@ -273,7 +292,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                            meta.num_bin - 3).astype(jnp.int32)
 
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
-                depth=None, rand_tag=0, used=None):
+                depth=None, rand_tag=0, used=None, branch=None):
+        cm = col_mask
+        if params.interaction_sets:
+            cm = cm & allowed_of(branch)
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
@@ -286,7 +308,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             kw["cegb_used"] = used
         return find_best_split(to_feature_hist(hist, sum_g, sum_h),
                                meta.num_bin, meta.missing_type,
-                               meta.default_bin, meta.penalty, col_mask,
+                               meta.default_bin, meta.penalty, cm,
                                sum_g, sum_h, cnt, parent_out, sp,
                                is_cat_feature=meta.is_cat, **kw)
 
@@ -336,10 +358,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     inf = jnp.asarray(jnp.inf, f32)
     if cegb_used is None:
         cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
+    branch0 = jnp.zeros(
+        (L, num_features) if params.interaction_sets else (1, 1), bool)
     root_best = best_of(root_hist, sum_g0, sum_h0, cnt0,
                         jnp.asarray(0.0, f32), -inf, inf,
                         jnp.asarray(0, jnp.int32), rand_tag=0,
-                        used=cegb_used)
+                        used=cegb_used, branch=branch0[0])
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -399,6 +423,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    leaf_cmax=jnp.full(L if sp.has_monotone else 1, jnp.inf,
                                       f32),
                    cegb_used=cegb_used,
+                   leaf_branch=branch0,
                    done=jnp.asarray(False))
 
     def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
@@ -612,12 +637,21 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     pd = pd._replace(gain=pd.gain + refund)
             else:
                 used_vec = st.cegb_used
+            if params.interaction_sets:
+                child_branch = st.leaf_branch[best_leaf].at[feat].set(True)
+                leaf_branch = (st.leaf_branch.at[best_leaf].set(child_branch)
+                               .at[new_leaf].set(child_branch))
+            else:
+                child_branch = st.leaf_branch[0]
+                leaf_branch = st.leaf_branch
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
                              pd.left_output[best_leaf], l_min, l_max, depth,
-                             rand_tag=2 * i + 1, used=used_vec)
+                             rand_tag=2 * i + 1, used=used_vec,
+                             branch=child_branch)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
-                             depth, rand_tag=2 * i + 2, used=used_vec)
+                             depth, rand_tag=2 * i + 2, used=used_vec,
+                             branch=child_branch)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
@@ -630,6 +664,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           leaf_seg_cnt=leaf_seg_cnt,
                           leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
                           cegb_used=used_vec,
+                          leaf_branch=leaf_branch,
                           done=st.done)
 
         return jax.lax.cond(proceed, do_split,

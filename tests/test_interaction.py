@@ -1,0 +1,57 @@
+"""Interaction constraints (ref: config.h:585 interaction_constraints;
+col_sampler.hpp:91 GetByNode: a leaf splits only on its branch features
+plus sets containing the whole branch)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _collect_paths(tree):
+    """Set of features on each root->node path."""
+    paths = []
+
+    def walk(node, feats):
+        if node < 0:
+            paths.append(feats)
+            return
+        f = int(tree.split_feature[node])
+        walk(int(tree.left_child[node]), feats | {f})
+        walk(int(tree.right_child[node]), feats | {f})
+
+    if tree.num_leaves > 1:
+        walk(0, set())
+    return paths
+
+
+def test_branches_respect_interaction_sets():
+    rng = np.random.RandomState(6)
+    n = 3000
+    X = rng.rand(n, 4)
+    # y needs interactions both within and across groups
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.05 * rng.randn(n))
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 5,
+              "interaction_constraints": "[0,1],[2,3]"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    b._gbdt._sync_model()
+    allowed = [{0, 1}, {2, 3}]
+    for t in b._gbdt.models_:
+        for feats in _collect_paths(t):
+            assert any(feats <= s for s in allowed), feats
+
+
+def test_unconstrained_mixes_features():
+    rng = np.random.RandomState(6)
+    n = 3000
+    X = rng.rand(n, 4)
+    y = X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.05 * rng.randn(n)
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbosity": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    b._gbdt._sync_model()
+    allowed = [{0, 1}, {2, 3}]
+    mixed = any(not any(feats <= s for s in allowed)
+                for t in b._gbdt.models_ for feats in _collect_paths(t))
+    assert mixed  # non-vacuity: without constraints branches mix groups
