@@ -8,8 +8,10 @@ recursive-halving reduce-scatter over TCP, rows are sharded over a mesh axis
 and XLA inserts the psum/all_gather collectives over ICI/DCN.
 """
 
+from .binning import merged_bin_mappers, sample_rows
 from .data_parallel import (data_parallel_shardings, grow_params_for_mesh, make_mesh,
                             shard_for_data_parallel)
 
-__all__ = ["data_parallel_shardings", "grow_params_for_mesh", "make_mesh",
+__all__ = [
+    "merged_bin_mappers", "sample_rows","data_parallel_shardings", "grow_params_for_mesh", "make_mesh",
            "shard_for_data_parallel"]

@@ -1,0 +1,56 @@
+"""Distributed binning for multi-host data loading.
+
+The reference shards bin-FINDING across ranks and allgathers the bin
+mappers (ref: dataset_loader.cpp:1070 ConstructBinMappersFromTextData:
+rank k finds bins for its feature block, then Network::Allgather merges
+the serialized mappers).  Under JAX's single-controller SPMD model the
+natural equivalent is sample-replicated binning: each host samples its
+local row shard, the small samples are allgathered
+(bin_construct_sample_cnt rows total), and every host computes IDENTICAL
+mappers deterministically from the merged sample — no mapper
+serialization, and cross-rank determinism holds by construction (the
+property the reference's SyncUpGlobalBestSplit relies on downstream).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..io.binning import BIN_NUMERICAL, BinMapper
+
+
+def sample_rows(X_local: np.ndarray, sample_cnt: int,
+                seed: int = 1) -> np.ndarray:
+    """Per-host row sample (ref: dataset_loader.cpp:1022
+    SampleTextDataFromFile)."""
+    n = X_local.shape[0]
+    if n <= sample_cnt:
+        return np.asarray(X_local)
+    rng = np.random.RandomState(seed)
+    return np.asarray(X_local)[rng.choice(n, sample_cnt, replace=False)]
+
+
+def merged_bin_mappers(local_samples: Sequence[np.ndarray],
+                       max_bin: int = 255, min_data_in_bin: int = 3,
+                       **find_kwargs) -> List[BinMapper]:
+    """Bin mappers every rank agrees on, from the allgathered per-host
+    samples.  `local_samples` stands in for the result of an all_gather
+    over hosts (in-process here; jax.experimental.multihost_utils.
+    process_allgather in a real multi-host job)."""
+    merged = np.concatenate([np.asarray(s, np.float64)
+                             for s in local_samples], axis=0)
+    total = merged.shape[0]
+    mappers = []
+    for f in range(merged.shape[1]):
+        col = merged[:, f]
+        nonzero = col[~((col == 0) | np.isnan(col))]
+        nan_vals = col[np.isnan(col)]
+        vals = np.concatenate([nonzero, nan_vals])
+        m = BinMapper()
+        m.find_bin(vals, total, max_bin,
+                   min_data_in_bin=min_data_in_bin,
+                   bin_type=BIN_NUMERICAL, **find_kwargs)
+        mappers.append(m)
+    return mappers

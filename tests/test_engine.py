@@ -245,3 +245,25 @@ def test_pred_early_stop():
     es_off = b.predict(X, pred_early_stop=True,
                        pred_early_stop_margin=1e9)
     np.testing.assert_allclose(full, es_off, rtol=1e-12)
+
+
+def test_path_smooth():
+    """path_smooth blends leaf outputs toward the parent
+    (ref: CalculateSplittedLeafOutput USE_SMOOTHING,
+    feature_histogram.hpp:716): predictions shrink toward the mean and
+    small-leaf variance drops."""
+    rng = np.random.RandomState(9)
+    X = rng.rand(1500, 3)
+    y = 2 * X[:, 0] + 0.5 * rng.randn(1500)
+    base = {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+            "min_data_in_leaf": 2}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    b1 = lgb.train({**base, "path_smooth": 100.0},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    from lightgbm_tpu.boosting.model_io import save_model_to_string
+    assert (save_model_to_string(b0._gbdt)
+            != save_model_to_string(b1._gbdt))
+    # smoothed model is less extreme (regularized toward parents)
+    p0, p1 = b0.predict(X), b1.predict(X)
+    assert np.std(p1 - p1.mean()) < np.std(p0 - p0.mean())
+    assert np.corrcoef(p1, y)[0, 1] > 0.7
