@@ -56,6 +56,17 @@ class Dataset:
                 self._core.metadata.set_label(self.label)
         else:
             data = self.data
+            if (type(data).__module__ or "").startswith("pyarrow"):
+                # Arrow ingestion (ref: include/LightGBM/arrow.h;
+                # LGBM_DatasetCreateFromArrow, c_api.h:214): zero-copy-ish
+                # columnar tables/batches become the feature matrix
+                if self.feature_name == "auto" and hasattr(data,
+                                                           "column_names"):
+                    self.feature_name = list(data.column_names)
+                data = np.column_stack([
+                    np.asarray(data.column(i).to_numpy(
+                        zero_copy_only=False), dtype=np.float64)
+                    for i in range(data.num_columns)])
             if hasattr(data, "values"):  # pandas
                 if self.feature_name == "auto":
                     self.feature_name = list(map(str, data.columns))

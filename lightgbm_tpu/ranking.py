@@ -38,6 +38,9 @@ class RankingObjective(ObjectiveFunction):
     def __init__(self, config: Config):
         super().__init__(config)
         self.seed = config.objective_seed
+        self.learning_rate = config.learning_rate
+        self.position_bias_regularization = (
+            config.lambdarank_position_bias_regularization)
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -45,12 +48,22 @@ class RankingObjective(ObjectiveFunction):
             log.fatal("Ranking tasks require query information")
         self.query_boundaries = np.asarray(metadata.query_boundaries)
         self.num_queries = len(self.query_boundaries) - 1
+        # position bias factors (ref: rank_objective.hpp:43-60,290):
+        # per-position offsets added to scores before the pairwise
+        # lambdas, updated by a Newton step every iteration
+        self.positions = (None if metadata.position is None
+                          else np.asarray(metadata.position, np.int64))
+        if self.positions is not None:
+            self.num_position_ids = int(self.positions.max()) + 1
+            self.pos_biases = np.zeros(self.num_position_ids)
 
     def get_gradients_host(self, score: np.ndarray):
         """score [n] -> (grad, hess) on host (ref: RankingObjective::GetGradients)."""
         n = len(score)
         lambdas = np.zeros(n, dtype=np.float64)
         hessians = np.zeros(n, dtype=np.float64)
+        if self.positions is not None:
+            score = score + self.pos_biases[self.positions]  # hpp:68
         for q in range(self.num_queries):
             a, b = int(self.query_boundaries[q]), int(self.query_boundaries[q + 1])
             l, h = self._one_query(q, self.label[a:b], score[a:b])
@@ -59,7 +72,22 @@ class RankingObjective(ObjectiveFunction):
         if self.weight is not None:
             lambdas *= self.weight
             hessians *= self.weight
+        if self.positions is not None:
+            self._update_position_bias(lambdas, hessians)
         return lambdas.astype(np.float32), hessians.astype(np.float32)
+
+    def _update_position_bias(self, lambdas, hessians):
+        """Newton step on the per-position utility derivatives
+        (ref: rank_objective.hpp:290 UpdatePositionBiasFactors)."""
+        P = self.num_position_ids
+        fd = -np.bincount(self.positions, weights=lambdas, minlength=P)
+        sd = -np.bincount(self.positions, weights=hessians, minlength=P)
+        cnt = np.bincount(self.positions, minlength=P)
+        reg = self.position_bias_regularization
+        fd -= self.pos_biases * reg * cnt
+        sd -= reg * cnt
+        self.pos_biases += (self.learning_rate * fd
+                            / (np.abs(sd) + 0.001))
 
     def get_gradients(self, score, label, weight):  # pragma: no cover
         raise RuntimeError("ranking objectives compute gradients host-side; "

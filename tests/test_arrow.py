@@ -1,0 +1,36 @@
+"""Arrow ingestion (ref: include/LightGBM/arrow.h;
+LGBM_DatasetCreateFromArrow c_api.h:214; tests/python_package_test/
+test_arrow.py)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+import lightgbm_tpu as lgb
+
+
+def test_dataset_from_arrow_table():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1200, 3)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    table = pa.table({f"f{i}": X[:, i] for i in range(3)})
+    ds = lgb.Dataset(table, label=y)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1}, ds, num_boost_round=10)
+    acc = float(np.mean((b.predict(X) > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+    assert ds.feature_names() == ["f0", "f1", "f2"]
+
+
+def test_arrow_matches_numpy_training():
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 4)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(800)
+    table = pa.table({f"c{i}": X[:, i] for i in range(4)})
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    b_arrow = lgb.train(params, lgb.Dataset(table, label=y),
+                        num_boost_round=5)
+    b_np = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(b_arrow.predict(X), b_np.predict(X),
+                               rtol=1e-6)

@@ -294,3 +294,30 @@ def test_num_boost_round_alias_precedence():
     b2 = lgb.train({"objective": "regression", "verbosity": -1},
                    lgb.Dataset(X, label=y), num_boost_round=7)
     assert b2.num_trees() == 7
+
+
+def test_lambdarank_position_bias():
+    """Position bias factors (rank_objective.hpp:290): clicks biased
+    toward top positions train learnable per-position offsets; the model
+    with bias correction ranks the true-relevance feature higher."""
+    rng = np.random.RandomState(8)
+    n_q, per_q = 80, 10
+    n = n_q * per_q
+    X = rng.rand(n, 2)
+    true_rel = (X[:, 0] > 0.6).astype(int)
+    position = np.tile(np.arange(per_q), n_q).astype(np.int32)
+    # observed label: true relevance AND seen (top positions seen more)
+    seen = rng.rand(n) < (1.0 / (1 + position))
+    label = (true_rel & seen).astype(np.float64)
+    group = np.full(n_q, per_q)
+    ds = lgb.Dataset(X, label=label, group=group, position=position)
+    b = lgb.train({"objective": "lambdarank", "num_leaves": 7,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "lambdarank_position_bias_regularization": 0.1},
+                  ds, num_boost_round=10)
+    obj = b._gbdt.objective
+    assert obj.positions is not None
+    assert obj.pos_biases.shape == (per_q,)
+    assert np.any(obj.pos_biases != 0)
+    # learned biases must decrease with position (top seen more)
+    assert obj.pos_biases[0] > obj.pos_biases[-1]
