@@ -787,6 +787,9 @@ class GBDT:
                 with global_timer.scope("GBDT::grow_tree"):
                     grow_kw = ({"cegb_used": self._cegb_used}
                                if self._cegb_used is not None else {})
+                    if self.config.extra_trees:
+                        grow_kw["extra_tag"] = np.int32(
+                            self.iter_ * K + k)
                     arrays, leaf_id = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,
@@ -1170,21 +1173,27 @@ class GBDT:
         end = min(start_iteration + num_iteration, total_iters)
         out = np.zeros((K, n))
         use_es = pred_early_stop and not self.average_output_
-        active = np.ones(n, bool) if use_es else None
+        active_idx = np.arange(n) if use_es else None
+        Xa = X
         for i, it in enumerate(range(start_iteration, end)):
             if use_es and i > 0 and i % pred_early_stop_freq == 0:
+                sub = out[:, active_idx]
                 if K == 1:
-                    margin = 2.0 * np.abs(out[0])
+                    margin = 2.0 * np.abs(sub[0])
                 else:
-                    top2 = np.partition(out, K - 2, axis=0)[K - 2:]
+                    top2 = np.partition(sub, K - 2, axis=0)[K - 2:]
                     margin = top2[1] - top2[0]
-                active &= margin <= pred_early_stop_margin
-                if not active.any():
+                keep = margin <= pred_early_stop_margin
+                active_idx = active_idx[keep]
+                if len(active_idx) == 0:
                     break
+                # the point of early stopping is SKIPPING work: later
+                # trees only traverse the still-active rows
+                Xa = X[active_idx]
             for k in range(K):
-                pred = self.models_[it * K + k].predict(X)
+                pred = self.models_[it * K + k].predict(Xa)
                 if use_es:
-                    out[k][active] += pred[active]
+                    out[k][active_idx] += pred
                 else:
                     out[k] += pred
         if self.average_output_ and end > start_iteration:

@@ -91,18 +91,17 @@ def plan_bundles(binned: np.ndarray, mappers, used_features,
     for f in order:
         f = int(f)
         placed = False
-        if True:
-            for gi in range(len(groups)):
-                if group_bins[gi] + nbins[f] > MAX_BUNDLE_BINS:
-                    continue
-                conflicts = int((group_nz[gi] & nz[f]).sum())
-                if group_conflicts[gi] + conflicts <= cap:
-                    groups[gi].append(f)
-                    group_nz[gi] |= nz[f]
-                    group_conflicts[gi] += conflicts
-                    group_bins[gi] += int(nbins[f])
-                    placed = True
-                    break
+        for gi in range(len(groups)):
+            if group_bins[gi] + nbins[f] > MAX_BUNDLE_BINS:
+                continue
+            conflicts = int((group_nz[gi] & nz[f]).sum())
+            if group_conflicts[gi] + conflicts <= cap:
+                groups[gi].append(f)
+                group_nz[gi] |= nz[f]
+                group_conflicts[gi] += conflicts
+                group_bins[gi] += int(nbins[f])
+                placed = True
+                break
         if not placed:
             groups.append([f])
             group_nz.append(nz[f].copy())

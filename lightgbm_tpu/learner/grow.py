@@ -199,7 +199,8 @@ def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
 @functools.partial(jax.jit, static_argnames=("params",))
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               row_mask: jnp.ndarray, col_mask: jnp.ndarray, meta: FeatureMeta,
-              params: GrowParams, cegb_used: jnp.ndarray = None):
+              params: GrowParams, cegb_used: jnp.ndarray = None,
+              extra_tag: jnp.ndarray = None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -281,15 +282,20 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     if sp.extra_trees:
         _extra_key = jax.random.PRNGKey(sp.extra_seed)
+        if extra_tag is not None:
+            # vary draws across trees/iterations (the reference's rand_
+            # is stateful over the whole run)
+            _extra_key = jax.random.fold_in(_extra_key, extra_tag)
 
     def _rand_bins(tag):
         """One random threshold per feature for this leaf scan
-        (ref: feature_histogram.hpp:204 rand.NextInt(0, num_bin - 2))."""
+        (ref: feature_histogram.hpp:204 rand.NextInt(0, num_bin - 2);
+        2-bin features evaluate threshold 0)."""
         u = jax.random.uniform(jax.random.fold_in(_extra_key, tag),
                                (num_features,))
         span = jnp.maximum(meta.num_bin - 2, 1).astype(f32)
-        return jnp.minimum((u * span).astype(jnp.int32),
-                           meta.num_bin - 3).astype(jnp.int32)
+        return jnp.clip((u * span).astype(jnp.int32), 0,
+                        jnp.maximum(meta.num_bin - 3, 0)).astype(jnp.int32)
 
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
                 depth=None, rand_tag=0, used=None, branch=None):
@@ -524,10 +530,17 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         else:
             best_leaf = jnp.argmax(sel_gain).astype(jnp.int32)
             proceed = jnp.logical_and(~st.done, sel_gain[best_leaf] > 0.0)
+            # dynamic budget guard: with forced splits the loop trip
+            # count exceeds the remaining budget (skipped forced steps
+            # hand their slot back to best-gain growth)
+            proceed = jnp.logical_and(proceed, st.tree.num_leaves < L)
 
         def do_split(st: _State) -> _State:
-            node = i                      # node index == step (num_leaves-1)
-            new_leaf = i + 1              # new right-child leaf index
+            # dynamic node numbering: equals the step index in pure
+            # best-gain growth, but skipped forced splits make them
+            # diverge (node index must track the actual tree size)
+            node = st.tree.num_leaves - 1
+            new_leaf = st.tree.num_leaves
             pd = st.pending
             feat = pd.feature[best_leaf]
             thr = pd.threshold[best_leaf]
@@ -667,6 +680,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           leaf_branch=leaf_branch,
                           done=st.done)
 
+        if forced_leaf is not None:
+            # an invalid forced split (empty child) is skipped; growth
+            # continues (ForceSplits abandons forcing, not the tree)
+            return jax.lax.cond(proceed, do_split, lambda s: s, st)
         return jax.lax.cond(proceed, do_split,
                             lambda s: s._replace(done=jnp.asarray(True)), st)
 
@@ -718,10 +735,21 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     for k, (fleaf, ffeat, fthr) in enumerate(params.forced_splits):
         if k >= L - 1:
             break
+        old_pending = state.pending
+        old_nl = state.tree.num_leaves
         state = forced_pending(state, fleaf, ffeat, fthr)
         state = body(k, state, forced_leaf=fleaf)
-    if L > 1 and KF < L - 1:
-        state = jax.lax.fori_loop(min(KF, L - 1), L - 1, body, state)
+        # a skipped forced split must not clobber the leaf's real
+        # pending entry (ForceSplits abandons forcing, growth continues)
+        applied = state.tree.num_leaves > old_nl
+        state = state._replace(pending=jax.tree.map(
+            lambda new, old: jnp.where(applied, new, old),
+            state.pending, old_pending))
+    if L > 1:
+        # the full trip count runs even after forced steps: skipped
+        # forced splits return their slot to best-gain growth, and the
+        # dynamic num_leaves < L guard in body enforces the budget
+        state = jax.lax.fori_loop(0, L - 1, body, state)
     return state.tree, state.leaf_id
 
 

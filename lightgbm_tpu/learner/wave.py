@@ -74,7 +74,8 @@ def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
 def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    row_mask: jnp.ndarray, col_mask: jnp.ndarray,
                    meta: FeatureMeta, params: GrowParams,
-                   cegb_used: jnp.ndarray = None):
+                   cegb_used: jnp.ndarray = None,
+                   extra_tag: jnp.ndarray = None):
     """Grow one tree by waves.  Same contract as grow.grow_tree."""
     from ..ops.split import MISSING_NAN, MISSING_ZERO
 
@@ -110,15 +111,19 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     if sp.extra_trees:
         _extra_key = jax.random.PRNGKey(sp.extra_seed)
+        if extra_tag is not None:
+            _extra_key = jax.random.fold_in(_extra_key, extra_tag)
 
         def _rand_bins(tag):
             """[NLp_max, F] random thresholds for this wave's leaf scans
-            (ref: feature_histogram.hpp:204 USE_RAND)."""
+            (ref: feature_histogram.hpp:204 USE_RAND; 2-bin features
+            evaluate threshold 0)."""
             u = jax.random.uniform(jax.random.fold_in(_extra_key, tag),
                                    (Lp, num_features))
             span = jnp.maximum(meta.num_bin - 2, 1).astype(f32)[None, :]
-            return jnp.minimum((u * span).astype(jnp.int32),
-                               (meta.num_bin - 3)[None, :]).astype(jnp.int32)
+            return jnp.clip((u * span).astype(jnp.int32), 0,
+                            jnp.maximum(meta.num_bin - 3, 0)[None, :]
+                            ).astype(jnp.int32)
 
     if sp.has_monotone:
         def _pen_of(depth):
@@ -129,8 +134,6 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                        1.0 - pen / jnp.exp2(d) + 1e-15,
                                        1.0 - jnp.exp2(pen - 1.0 - d)
                                        + 1e-15))
-
-        pass
 
     def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used):
         h = bundle_hist_to_features(h, sg, sh, meta, B, hist_B,

@@ -267,3 +267,19 @@ def test_path_smooth():
     p0, p1 = b0.predict(X), b1.predict(X)
     assert np.std(p1 - p1.mean()) < np.std(p0 - p0.mean())
     assert np.corrcoef(p1, y)[0, 1] > 0.7
+
+
+def test_extra_trees_varies_across_trees():
+    """The random thresholds must differ between boosting iterations
+    (the reference's rand_ is stateful across the run)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(2000, 1)
+    y = X[:, 0] + 0.01 * rng.randn(2000)
+    b = lgb.train({"objective": "regression", "num_leaves": 2,
+                   "verbosity": -1, "extra_trees": True,
+                   "min_data_in_leaf": 5, "learning_rate": 0.01},
+                  lgb.Dataset(X, label=y), num_boost_round=6)
+    b._gbdt._sync_model()
+    thresholds = {round(float(t.threshold[0]), 6)
+                  for t in b._gbdt.models_ if t.num_leaves > 1}
+    assert len(thresholds) > 1, thresholds

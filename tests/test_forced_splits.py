@@ -54,3 +54,14 @@ def test_growth_continues_after_forced(tmp_path):
     X = rng.rand(2000, 3)
     y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(2000)
     assert np.corrcoef(b.predict(X), y)[0, 1] > 0.8
+
+
+def test_invalid_forced_split_is_skipped(tmp_path):
+    """A forced threshold outside the data range produces an empty child:
+    the forced split is abandoned but best-gain growth continues
+    (ForceSplits semantics), not a dead stump."""
+    b = _train_with_forced(tmp_path, {"feature": 2, "threshold": 99.0},
+                           leaves=8)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 8          # growth continued
+    assert t.split_feature[0] != 2    # forced split was skipped
