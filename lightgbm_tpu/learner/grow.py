@@ -536,11 +536,15 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             proceed = jnp.logical_and(proceed, st.tree.num_leaves < L)
 
         def do_split(st: _State) -> _State:
-            # dynamic node numbering: equals the step index in pure
-            # best-gain growth, but skipped forced splits make them
-            # diverge (node index must track the actual tree size)
-            node = st.tree.num_leaves - 1
-            new_leaf = st.tree.num_leaves
+            # node index == step index in pure best-gain growth (static,
+            # cheaper updates); skipped forced splits make them diverge,
+            # so forced configs track the actual tree size dynamically
+            if params.forced_splits:
+                node = st.tree.num_leaves - 1
+                new_leaf = st.tree.num_leaves
+            else:
+                node = i
+                new_leaf = i + 1
             pd = st.pending
             feat = pd.feature[best_leaf]
             thr = pd.threshold[best_leaf]
