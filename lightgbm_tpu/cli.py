@@ -53,11 +53,22 @@ def _task_train(cfg: Config, params: Dict[str, str]) -> None:
                                   reference=train_set))
         valid_names.append(f"valid_{i}" if len(cfg.valid) > 1 else "valid")
     init_model = cfg.input_model or None
+    callbacks = None
+    if cfg.snapshot_freq > 0:
+        # periodic checkpoints (ref: gbdt.cpp:244-248 snapshot_freq
+        # writes model.snapshot_iter_N; resume via input_model)
+        def _snapshot(env):
+            it = env.iteration + 1
+            if it % cfg.snapshot_freq == 0:
+                env.model.save_model(
+                    f"{cfg.output_model}.snapshot_iter_{it}")
+        _snapshot.order = 100
+        callbacks = [_snapshot]
     booster = train_api(dict(params), train_set,
                         num_boost_round=cfg.num_iterations,
                         valid_sets=valid_sets or None,
                         valid_names=valid_names or None,
-                        init_model=init_model)
+                        init_model=init_model, callbacks=callbacks)
     booster.save_model(cfg.output_model)
     log.info(f"Finished training; model saved to {cfg.output_model}")
 

@@ -95,3 +95,18 @@ def test_python_dash_m_entrypoint(tmp_path):
         cwd="/root/repo", env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert model.exists()
+
+
+def test_snapshot_freq(tmp_path):
+    """snapshot_freq writes model.snapshot_iter_N checkpoints
+    (ref: gbdt.cpp:244-248) that resume via input_model."""
+    model = tmp_path / "model.txt"
+    rc = main([f"data={BINARY}/binary.train", "objective=binary",
+               "num_iterations=6", "num_leaves=7", "verbosity=-1",
+               "snapshot_freq=2", f"output_model={model}"])
+    assert rc == 0
+    snaps = sorted(tmp_path.glob("model.txt.snapshot_iter_*"))
+    assert len(snaps) == 3, snaps
+    import lightgbm_tpu as lgb
+    b = lgb.Booster(model_file=str(snaps[0]))
+    assert b._gbdt.current_iteration() == 2

@@ -283,3 +283,18 @@ def test_extra_trees_varies_across_trees():
     thresholds = {round(float(t.threshold[0]), 6)
                   for t in b._gbdt.models_ if t.num_leaves > 1}
     assert len(thresholds) > 1, thresholds
+
+
+def test_cv():
+    """K-fold CV (ref: engine.py:580 cv): mean/stdv histories per metric,
+    stratified folds for binary."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(1200, 4)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    res = lgb.cv({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                  "metric": "auc"}, lgb.Dataset(X, label=y),
+                 num_boost_round=5, nfold=3, seed=3)
+    assert "valid auc-mean" in res and "valid auc-stdv" in res
+    assert len(res["valid auc-mean"]) == 5
+    assert res["valid auc-mean"][-1] > 0.8
+    assert all(s >= 0 for s in res["valid auc-stdv"])
