@@ -1,0 +1,97 @@
+"""Multi-process SPMD training (SURVEY §2.3 #6): N real OS processes,
+each with local devices, train the same sharded model via
+jax.distributed — the TPU-native analogue of the reference's N CLI
+workers over sockets (tests/distributed/_test_distributed.py pattern:
+train in every process, assert identical models across ranks)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1])
+out_path = sys.argv[2]
+port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.model_io import save_model_to_string
+
+rng = np.random.RandomState(3)
+n = 4096
+X = rng.rand(n, 6)
+logit = 4 * (X[:, 0] - 0.5) + 2 * X[:, 1] * X[:, 2] - X[:, 3]
+y = (rng.rand(n) < 1 / (1 + np.exp(-3 * logit))).astype(np.float64)
+
+booster = lgb.train(
+    {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+     "min_data_in_leaf": 5, "learning_rate": 0.2,
+     "tree_learner": "data", "tpu_growth_strategy": "leafwise"},
+    lgb.Dataset(X, label=y), num_boost_round=4)
+assert booster._gbdt.mesh is not None
+assert len(booster._gbdt.mesh.devices.ravel()) == 4  # 2 procs x 2 devs
+txt = save_model_to_string(booster._gbdt)
+with open(out_path, "w") as f:
+    f.write(txt)
+print(f"proc {pid} done", flush=True)
+"""
+
+
+@pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
+                    reason="multiproc disabled")
+def test_two_process_training_identical_models(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    outs = [tmp_path / f"model_{i}.txt" for i in range(2)]
+    port = "43917"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(outs[i]), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo") for i in range(2)]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        logs.append(out)
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+
+    texts = [o.read_text() for o in outs]
+    # every rank must write the IDENTICAL model file
+    # (_test_distributed.py's core assertion)
+    assert texts[0] == texts[1]
+
+    # and the multi-process model must match single-process training
+    # structurally (float payloads to rounded precision)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.model_io import save_model_to_string
+    rng = np.random.RandomState(3)
+    n = 4096
+    X = rng.rand(n, 6)
+    logit = 4 * (X[:, 0] - 0.5) + 2 * X[:, 1] * X[:, 2] - X[:, 3]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-3 * logit))).astype(np.float64)
+    b1 = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "verbosity": -1, "min_data_in_leaf": 5,
+                    "learning_rate": 0.2,
+                    "tpu_growth_strategy": "leafwise"},
+                   lgb.Dataset(X, label=y), num_boost_round=4)
+    serial = save_model_to_string(b1._gbdt)
+
+    def structure(txt):
+        txt = txt.split("\nparameters:")[0]
+        txt = "\n".join(l for l in txt.splitlines()
+                        if not l.startswith("tree_sizes="))
+        return re.sub(r"-?\d+\.\d+(e[-+]?\d+)?", "F", txt)
+
+    assert structure(texts[0]) == structure(serial)

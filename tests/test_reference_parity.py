@@ -96,6 +96,47 @@ def test_training_parity_same_data_same_params(oracle_run):
     assert corr > 0.97, corr
 
 
+@pytest.mark.parametrize("example,objective,extra", [
+    ("regression", "regression", ""),
+    ("multiclass_classification", "multiclass", "num_class = 5\n"),
+    ("lambdarank", "lambdarank", ""),
+    ("xendcg", "rank_xendcg", ""),
+])
+def test_model_interop_all_objectives(tmp_path, example, objective, extra):
+    """Every example family: a model trained by REAL LightGBM loads in
+    our Booster and reproduces the oracle's own predictions."""
+    ex = f"/root/reference/examples/{example}"
+    data = next(p for p in (f"{ex}/{example.split('_')[0]}.train",
+                            f"{ex}/rank.train")
+                if os.path.exists(p))
+    test_file = data.replace(".train", ".test")
+    conf = tmp_path / "train.conf"
+    model = tmp_path / "model.txt"
+    conf.write_text(
+        f"task = train\ndata = {data}\noutput_model = {model}\n"
+        f"objective = {objective}\nnum_iterations = 8\nnum_leaves = 15\n"
+        f"min_data_in_leaf = 20\ndeterministic = true\n"
+        f"force_row_wise = true\nverbosity = -1\n" + extra)
+    r = subprocess.run([ORACLE, f"config={conf}"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    pred_conf = tmp_path / "pred.conf"
+    pred_out = tmp_path / "pred.txt"
+    pred_conf.write_text(
+        f"task = predict\ndata = {test_file}\ninput_model = {model}\n"
+        f"output_result = {pred_out}\n")
+    r = subprocess.run([ORACLE, f"config={pred_conf}"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    ref_pred = np.loadtxt(pred_out)
+
+    booster = lgb.Booster(model_file=str(model))
+    from lightgbm_tpu.io.parser import parse_file
+    X, _, _ = parse_file(test_file, has_header=False, label_column="0")
+    ours = booster.predict(X)
+    np.testing.assert_allclose(ours, ref_pred, rtol=1e-4, atol=1e-6)
+
+
 def test_first_tree_root_split_matches(oracle_run):
     """With identical GreedyFindBin binning, the first tree's root split
     (feature, threshold) must match the reference exactly."""
