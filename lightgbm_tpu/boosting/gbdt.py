@@ -392,8 +392,10 @@ class GBDT:
             import re as _re
             inner_of = {f: i for i, f in enumerate(train_data.used_features)}
             sets = []
-            for grp in _re.findall(r"\[([^\]]*)\]",
-                                   config.interaction_constraints):
+            # accept both the string form "[0,1],[2,3]" and the python
+            # list-of-lists form (str() of which nests brackets)
+            for grp in _re.findall(r"\[([^\[\]]*)\]",
+                                   str(config.interaction_constraints)):
                 idxs = tuple(sorted(inner_of[int(tok)]
                                     for tok in grp.split(",")
                                     if tok.strip() != ""

@@ -514,6 +514,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return (st.order, leaf_id, st.leaf_start, st.leaf_seg_cnt, small_hist,
                 cnt_l, cnt_r, smaller_is_left)
 
+    KF = len(params.forced_splits)
+
     def body(i, st: _State, forced_leaf=None):
         # leaf selection (ref: serial_tree_learner.cpp:219 ArgMax over leaves);
         # max_depth gates children depth (ref: serial_tree_learner BeforeFindBestSplit)
@@ -663,12 +665,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 leaf_branch = st.leaf_branch
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
                              pd.left_output[best_leaf], l_min, l_max, depth,
-                             rand_tag=2 * i + 1, used=used_vec,
+                             rand_tag=2 * (i + KF) + 1, used=used_vec,
                              branch=child_branch)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
-                             depth, rand_tag=2 * i + 2, used=used_vec,
-                             branch=child_branch)
+                             depth, rand_tag=2 * (i + KF) + 2,
+                             used=used_vec, branch=child_branch)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
@@ -735,7 +737,6 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             cat_bitset=jnp.zeros(cat_bitset_words(B), jnp.int32))
         return st._replace(pending=_pending_set(st.pending, leaf, res))
 
-    KF = len(params.forced_splits)
     for k, (fleaf, ffeat, fthr) in enumerate(params.forced_splits):
         if k >= L - 1:
             break

@@ -34,23 +34,30 @@ def sample_rows(X_local: np.ndarray, sample_cnt: int,
 
 def merged_bin_mappers(local_samples: Sequence[np.ndarray],
                        max_bin: int = 255, min_data_in_bin: int = 3,
+                       bin_types: Sequence[int] = None,
                        **find_kwargs) -> List[BinMapper]:
     """Bin mappers every rank agrees on, from the allgathered per-host
     samples.  `local_samples` stands in for the result of an all_gather
     over hosts (in-process here; jax.experimental.multihost_utils.
-    process_allgather in a real multi-host job)."""
+    process_allgather in a real multi-host job).  `bin_types` gives each
+    feature's BIN_NUMERICAL/BIN_CATEGORICAL type (numerical default)."""
     merged = np.concatenate([np.asarray(s, np.float64)
                              for s in local_samples], axis=0)
     total = merged.shape[0]
     mappers = []
     for f in range(merged.shape[1]):
         col = merged[:, f]
-        nonzero = col[~((col == 0) | np.isnan(col))]
-        nan_vals = col[np.isnan(col)]
-        vals = np.concatenate([nonzero, nan_vals])
+        btype = (bin_types[f] if bin_types is not None else BIN_NUMERICAL)
+        if btype == BIN_NUMERICAL:
+            # zeros are implied by total - len(vals) (find_bin contract)
+            nonzero = col[~((col == 0) | np.isnan(col))]
+            nan_vals = col[np.isnan(col)]
+            vals = np.concatenate([nonzero, nan_vals])
+        else:
+            vals = col
         m = BinMapper()
         m.find_bin(vals, total, max_bin,
                    min_data_in_bin=min_data_in_bin,
-                   bin_type=BIN_NUMERICAL, **find_kwargs)
+                   bin_type=btype, **find_kwargs)
         mappers.append(m)
     return mappers

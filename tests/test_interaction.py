@@ -55,3 +55,19 @@ def test_unconstrained_mixes_features():
     mixed = any(not any(feats <= s for s in allowed)
                 for t in b._gbdt.models_ for feats in _collect_paths(t))
     assert mixed  # non-vacuity: without constraints branches mix groups
+
+
+def test_interaction_constraints_list_form():
+    """The python API's list-of-lists form must parse too."""
+    rng = np.random.RandomState(1)
+    X = rng.rand(800, 4)
+    y = X[:, 0] * X[:, 1] + 0.05 * rng.randn(800)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "interaction_constraints": [[0, 1], [2, 3]]},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    b._gbdt._sync_model()
+    allowed = [{0, 1}, {2, 3}]
+    for t in b._gbdt.models_:
+        for feats in _collect_paths(t):
+            assert any(feats <= s for s in allowed), feats

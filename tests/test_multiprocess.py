@@ -54,7 +54,10 @@ def test_two_process_training_identical_models(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     outs = [tmp_path / f"model_{i}.txt" for i in range(2)]
-    port = "43917"
+    import socket
+    with socket.socket() as sock:          # pick a free port per run
+        sock.bind(("localhost", 0))
+        port = str(sock.getsockname()[1])
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [subprocess.Popen(
