@@ -384,14 +384,23 @@ class Booster:
         out.append("}  // namespace lightgbm_tpu")
         return "\n".join(out)
 
-    def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
+    def model_to_string(self, num_iteration: int = None,
+                        start_iteration: int = 0,
                         importance_type: str = "split") -> str:
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
         return save_model_to_string(self._gbdt, num_iteration, start_iteration,
                                     importance_type)
 
-    def save_model(self, filename: str, num_iteration: int = -1,
+    def save_model(self, filename: str, num_iteration: int = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> "Booster":
+        """ref: basic.py Booster.save_model — num_iteration defaults to
+        best_iteration when early stopping fired."""
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
         save_model_to_file(self._gbdt, filename, num_iteration, start_iteration,
                            importance_type)
         return self
