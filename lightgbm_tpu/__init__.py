@@ -16,11 +16,15 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
+from .plotting import (create_tree_digraph, plot_importance,
+                       plot_metric, plot_split_value_histogram, plot_tree)
 from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
                       LGBMRegressor)
 from .utils.log import LightGBMError, register_callback
 
 __all__ = [
+    "plot_importance", "plot_metric", "plot_split_value_histogram",
+    "plot_tree", "create_tree_digraph",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "Booster",
     "Config",

@@ -248,6 +248,144 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_tree_per_iteration
 
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Replace this booster's model (ref: basic.py model_from_string)."""
+        self._gbdt = load_model_from_string(model_str)
+        return self
+
+    def dump_model(self, num_iteration: int = None,
+                   start_iteration: int = 0) -> dict:
+        """JSON model dump (ref: basic.py dump_model -> DumpModel;
+        gbdt_model_text.cpp DumpModel)."""
+        g = self._gbdt
+        g._sync_model()
+        K = g.num_tree_per_iteration
+        total_iters = len(g.models_) // max(K, 1)
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        if num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        cfg = g.config
+        ds = g.train_data
+        trees = [g.models_[it * K + k].to_json(it * K + k)
+                 for it in range(start_iteration, end) for k in range(K)]
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": cfg.num_class,
+            "num_tree_per_iteration": K,
+            "label_index": 0,
+            "max_feature_idx": (ds.num_total_features - 1
+                                if ds is not None else 0),
+            "objective": cfg.objective,
+            "feature_names": (ds.feature_names if ds is not None else []),
+            "tree_info": trees,
+        }
+
+    def trees_to_dataframe(self):
+        """Tree structure as a pandas DataFrame (ref: basic.py
+        trees_to_dataframe)."""
+        import pandas as pd
+        g = self._gbdt
+        g._sync_model()
+        rows = []
+        names = (g.train_data.feature_names if g.train_data is not None
+                 else None)
+        for ti, tree in enumerate(g.models_):
+            nl = tree.num_leaves
+            for i in range(max(nl - 1, 0)):
+                f = int(tree.split_feature[i])
+                rows.append(dict(
+                    tree_index=ti, node_depth=None,
+                    node_index=f"{ti}-S{i}",
+                    split_feature=(names[f] if names and f < len(names)
+                                   else f"Column_{f}"),
+                    split_gain=float(tree.split_gain[i]),
+                    threshold=float(tree.threshold[i]),
+                    decision_type="<=",
+                    left_child=int(tree.left_child[i]),
+                    right_child=int(tree.right_child[i]),
+                    value=float(tree.internal_value[i]),
+                    weight=float(tree.internal_weight[i]),
+                    count=int(tree.internal_count[i])))
+            for l in range(nl):
+                rows.append(dict(
+                    tree_index=ti, node_depth=int(tree.leaf_depth[l]),
+                    node_index=f"{ti}-L{l}", split_feature=None,
+                    split_gain=None, threshold=None, decision_type=None,
+                    left_child=None, right_child=None,
+                    value=float(tree.leaf_value[l]),
+                    weight=float(tree.leaf_weight[l]),
+                    count=int(tree.leaf_count[l])))
+        return pd.DataFrame(rows)
+
+    def lower_bound(self) -> float:
+        """Min possible raw prediction (ref: gbdt.h GetLowerBoundValue)."""
+        self._gbdt._sync_model()
+        return float(sum(t.leaf_value[:t.num_leaves].min()
+                         for t in self._gbdt.models_))
+
+    def upper_bound(self) -> float:
+        """Max possible raw prediction (ref: gbdt.h GetUpperBoundValue)."""
+        self._gbdt._sync_model()
+        return float(sum(t.leaf_value[:t.num_leaves].max()
+                         for t in self._gbdt.models_))
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Random shuffle of tree order (ref: gbdt.h:114 ShuffleModels)."""
+        g = self._gbdt
+        g._sync_model()
+        K = g.num_tree_per_iteration
+        total = len(g.models_) // max(K, 1)
+        end = total if end_iteration < 0 else min(end_iteration, total)
+        idx = np.arange(start_iteration, end)
+        np.random.RandomState(g.config.seed).shuffle(idx)
+        blocks = [g.models_[i * K:(i + 1) * K] for i in range(total)]
+        reordered = blocks[:start_iteration] + [blocks[i] for i in idx] \
+            + blocks[end:]
+        g.models_ = [t for b in reordered for t in b]
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Drop the training dataset reference (ref: basic.py
+        free_dataset)."""
+        self._train_set = None
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Update mutable training parameters (ref: basic.py
+        reset_parameter -> LGBM_BoosterResetParameter); used by the
+        reset_parameter callback (e.g. learning-rate schedules)."""
+        g = self._gbdt
+        for k, v in params.items():
+            if hasattr(g.config, k):
+                setattr(g.config, k, v)
+        if "learning_rate" in params:
+            g.shrinkage_rate = float(params["learning_rate"])
+        self.params.update(params)
+        return self
+
+    def eval(self, data: "Dataset", name: str, feval=None):
+        """Evaluate on an arbitrary dataset (ref: basic.py Booster.eval)."""
+        if name not in self.name_valid_sets:
+            self.add_valid(data, name)
+            # newly added sets start at init score only: replay the
+            # current model's raw predictions into the score buffer
+            g = self._gbdt
+            core = data._core_or_construct()
+            X = g._raw_or_reconstruct(core)
+            raw = g.predict_raw(np.asarray(X, np.float64))
+            g.valid_scores[-1] += (raw.T if raw.ndim == 2
+                                   else raw[None, :])
+        return [e for e in self.eval_valid(feval) if e[0] == name]
+
     # ------------------------------------------------------------------
     def eval_train(self, feval=None):
         return self._format_eval("training", self._gbdt.eval_train(),

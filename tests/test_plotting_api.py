@@ -1,0 +1,91 @@
+"""Plotting + extended Booster API (ref: plotting.py; basic.py Booster)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(1000)
+    return lgb.train({"objective": "regression", "num_leaves": 7,
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=y), num_boost_round=5), X, y
+
+
+def test_plot_importance(booster):
+    b, X, y = booster
+    ax = lgb.plot_importance(b)
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert labels  # informative features present
+    ax2 = lgb.plot_importance(b, importance_type="gain")
+    assert ax2 is not None
+
+
+def test_plot_metric(booster):
+    rng = np.random.RandomState(1)
+    X = rng.randn(600, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    hist = {}
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+               "metric": "auc"},
+              lgb.Dataset(X[:400], label=y[:400]), num_boost_round=5,
+              valid_sets=[lgb.Dataset(X[400:], label=y[400:])],
+              valid_names=["valid"],
+              callbacks=[lgb.record_evaluation(hist)])
+    ax = lgb.plot_metric(hist, metric="auc")
+    assert ax.get_title() == "Metric during training"
+
+
+def test_plot_split_value_histogram(booster):
+    b, X, y = booster
+    ax = lgb.plot_split_value_histogram(b, 0)
+    assert ax is not None
+
+
+def test_dump_model_and_dataframe(booster):
+    b, X, y = booster
+    d = b.dump_model()
+    assert d["name"] == "tree"
+    assert len(d["tree_info"]) == 5
+    assert "tree_structure" in d["tree_info"][0] or d["tree_info"][0]
+    df = b.trees_to_dataframe()
+    assert len(df) > 5
+    assert set(df["tree_index"]) == set(range(5))
+
+
+def test_bounds_and_shuffle(booster):
+    b, X, y = booster
+    lo, hi = b.lower_bound(), b.upper_bound()
+    raw = b.predict(X, raw_score=True)
+    assert lo <= raw.min() and raw.max() <= hi
+    pred_before = b.predict(X)
+    b.shuffle_models()
+    np.testing.assert_allclose(b.predict(X), pred_before, rtol=1e-12)
+
+
+def test_eval_arbitrary_dataset(booster):
+    b, X, y = booster
+    res = b.eval(lgb.Dataset(X, label=y), "holdout")
+    assert res and res[0][0] == "holdout"
+    assert np.isfinite(res[0][2])
+
+
+def test_reset_parameter_callback():
+    rng = np.random.RandomState(2)
+    X = rng.randn(800, 3)
+    y = X[:, 0]
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1, "learning_rate": 0.5},
+                  lgb.Dataset(X, label=y), num_boost_round=6,
+                  callbacks=[lgb.reset_parameter(
+                      learning_rate=lambda it: 0.5 * (0.5 ** it))])
+    b._gbdt._sync_model()
+    shr = [t.shrinkage for t in b._gbdt.models_ if t.num_leaves > 1]
+    assert shr[0] > shr[-1]
