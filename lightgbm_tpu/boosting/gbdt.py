@@ -328,6 +328,8 @@ class GBDT:
             has_bundles=bp is not None,
             group_max_bin=(0 if bp is None
                            else int(bp.group_num_bin.max())),
+            feature_fraction_bynode=config.feature_fraction_bynode,
+            bynode_seed=config.feature_fraction_seed + 1,
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -789,7 +791,8 @@ class GBDT:
                 with global_timer.scope("GBDT::grow_tree"):
                     grow_kw = ({"cegb_used": self._cegb_used}
                                if self._cegb_used is not None else {})
-                    if self.config.extra_trees:
+                    if (self.config.extra_trees
+                            or self.config.feature_fraction_bynode < 1.0):
                         grow_kw["extra_tag"] = np.int32(
                             self.iter_ * K + k)
                     arrays, leaf_id = self._grow_fn(

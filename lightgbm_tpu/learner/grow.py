@@ -91,6 +91,10 @@ class GrowParams(NamedTuple):
     # tuple of tuples of inner feature indices; a leaf may split only on
     # its branch features plus sets containing the whole branch
     interaction_sets: tuple = ()
+    # per-node column sampling (ref: col_sampler.hpp fraction_bynode_):
+    # each leaf scan draws a fresh feature subset of this fraction
+    feature_fraction_bynode: float = 1.0
+    bynode_seed: int = 2
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -287,6 +291,22 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # is stateful over the whole run)
             _extra_key = jax.random.fold_in(_extra_key, extra_tag)
 
+    use_bynode = params.feature_fraction_bynode < 1.0
+    if use_bynode:
+        _bynode_key = jax.random.PRNGKey(params.bynode_seed)
+        if extra_tag is not None:
+            _bynode_key = jax.random.fold_in(_bynode_key, extra_tag)
+        _bynode_k = max(1, int(round(
+            params.feature_fraction_bynode * num_features)))
+
+        def _bynode_mask(tag):
+            """Exactly-k column subset per leaf scan
+            (ref: col_sampler.hpp GetByNode sampling k indices)."""
+            u = jax.random.uniform(jax.random.fold_in(_bynode_key, tag),
+                                   (num_features,))
+            kth = jax.lax.top_k(u, _bynode_k)[0][-1]
+            return u >= kth
+
     def _rand_bins(tag):
         """One random threshold per feature for this leaf scan
         (ref: feature_histogram.hpp:204 rand.NextInt(0, num_bin - 2);
@@ -302,6 +322,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cm = col_mask
         if params.interaction_sets:
             cm = cm & allowed_of(branch)
+        if use_bynode:
+            cm = cm & _bynode_mask(rand_tag)
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,

@@ -135,7 +135,23 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                        1.0 - jnp.exp2(pen - 1.0 - d)
                                        + 1e-15))
 
-    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used):
+    use_bynode = params.feature_fraction_bynode < 1.0
+    if use_bynode:
+        _bynode_key = jax.random.PRNGKey(params.bynode_seed)
+        if extra_tag is not None:
+            _bynode_key = jax.random.fold_in(_bynode_key, extra_tag)
+        _bynode_k = max(1, int(round(
+            params.feature_fraction_bynode * num_features)))
+
+        def _bynode_masks(tag):
+            """[NLp_max, F] exactly-k column subsets per leaf scan
+            (ref: col_sampler.hpp GetByNode)."""
+            u = jax.random.uniform(jax.random.fold_in(_bynode_key, tag),
+                                   (Lp, num_features))
+            kth = jax.lax.top_k(u, _bynode_k)[0][:, -1:]
+            return u >= kth
+
+    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used, bym):
         h = bundle_hist_to_features(h, sg, sh, meta, B, hist_B,
                                     params.has_bundles)
         kw = {}
@@ -147,9 +163,10 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if sp.has_cegb:
             kw["cegb_coupled"] = meta.cegb_coupled
             kw["cegb_used"] = used
+        cm = col_mask if bym is None else (col_mask & bym)
         return find_best_split(
             h, meta.num_bin, meta.missing_type, meta.default_bin,
-            meta.penalty, col_mask, sg, sh, c, po, sp,
+            meta.penalty, cm, sg, sh, c, po, sp,
             is_cat_feature=meta.is_cat, **kw)
 
     best_vm = jax.vmap(_best_one,
@@ -158,7 +175,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if sp.has_monotone else None,
                                 0 if sp.has_monotone else None,
                                 0 if sp.extra_trees else None,
-                                None))
+                                None,
+                                0 if use_bynode else None))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
@@ -211,8 +229,11 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         mono_args = ((leaf_cmin[:NLp], leaf_cmax[:NLp],
                       tree.leaf_depth[:NLp]) if sp.has_monotone
                      else (None, None, None))
+        bym = (_bynode_masks(tree.num_leaves)[:NLp] if use_bynode
+               else None)
         best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                       counts, leaf_out[:NLp], *mono_args, rb, used_vec)
+                       counts, leaf_out[:NLp], *mono_args, rb, used_vec,
+                       bym)
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget
