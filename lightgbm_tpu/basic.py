@@ -251,6 +251,10 @@ class Booster:
     def model_from_string(self, model_str: str) -> "Booster":
         """Replace this booster's model (ref: basic.py model_from_string)."""
         self._gbdt = load_model_from_string(model_str)
+        self._train_set = None
+        self.name_valid_sets = []
+        self.best_iteration = -1
+        self.best_score = {}
         return self
 
     def dump_model(self, num_iteration: int = None,
@@ -297,14 +301,17 @@ class Booster:
             nl = tree.num_leaves
             for i in range(max(nl - 1, 0)):
                 f = int(tree.split_feature[i])
+                is_cat = bool(tree.decision_type[i] & 1)
                 rows.append(dict(
                     tree_index=ti, node_depth=None,
                     node_index=f"{ti}-S{i}",
                     split_feature=(names[f] if names and f < len(names)
                                    else f"Column_{f}"),
                     split_gain=float(tree.split_gain[i]),
-                    threshold=float(tree.threshold[i]),
-                    decision_type="<=",
+                    threshold=("||".join(str(c)
+                                         for c in tree._cats_of_node(i))
+                               if is_cat else float(tree.threshold[i])),
+                    decision_type="==" if is_cat else "<=",
                     left_child=int(tree.left_child[i]),
                     right_child=int(tree.right_child[i]),
                     value=float(tree.internal_value[i]),
@@ -362,23 +369,54 @@ class Booster:
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """Update mutable training parameters (ref: basic.py
         reset_parameter -> LGBM_BoosterResetParameter); used by the
-        reset_parameter callback (e.g. learning-rate schedules)."""
+        reset_parameter callback (e.g. learning-rate schedules).
+        Parameters baked into the jitted grow program are rebuilt
+        (changing them triggers one recompile)."""
         g = self._gbdt
         for k, v in params.items():
             if hasattr(g.config, k):
                 setattr(g.config, k, v)
         if "learning_rate" in params:
             g.shrinkage_rate = float(params["learning_rate"])
+        # rebuild the static split params the grow program was traced
+        # with; unknown/structural keys (num_leaves, max_bin, ...) are
+        # not resettable mid-training
+        _SPLIT_KEYS = {"lambda_l1", "lambda_l2", "min_data_in_leaf",
+                       "min_sum_hessian_in_leaf", "min_gain_to_split",
+                       "max_delta_step", "path_smooth", "cat_l2",
+                       "cat_smooth", "min_data_per_group",
+                       "max_cat_to_onehot", "max_cat_threshold"}
+        hit = _SPLIT_KEYS & set(params)
+        if hit and getattr(g, "grow_params", None) is not None:
+            sp = g.grow_params.split._replace(
+                **{k: params[k] for k in hit})
+            g.grow_params = g.grow_params._replace(split=sp)
+        if "max_depth" in params and getattr(g, "grow_params", None) is not None:
+            g.grow_params = g.grow_params._replace(
+                max_depth=int(params["max_depth"]))
         self.params.update(params)
         return self
 
     def eval(self, data: "Dataset", name: str, feval=None):
-        """Evaluate on an arbitrary dataset (ref: basic.py Booster.eval)."""
+        """Evaluate on an arbitrary dataset (ref: basic.py Booster.eval).
+        Works on trained AND loaded (predictor-mode) boosters."""
+        g = self._gbdt
+        if getattr(g, "valid_sets", None) is None:
+            # predictor-mode GBDT (loaded from file/string): evaluate
+            # directly without the training-time valid machinery
+            core = data._core_or_construct()
+            X = g._raw_or_reconstruct(core)
+            raw = g.predict_raw(np.asarray(X, np.float64))
+            score = raw.T if raw.ndim == 2 else raw[None, :]
+            metrics = create_metrics(g.config)
+            for m in metrics:
+                m.init(core.metadata, core.num_data)
+            results = g._eval(score, metrics, core)
+            return self._format_eval(name, results, feval, None)
         if name not in self.name_valid_sets:
             self.add_valid(data, name)
             # newly added sets start at init score only: replay the
             # current model's raw predictions into the score buffer
-            g = self._gbdt
             core = data._core_or_construct()
             X = g._raw_or_reconstruct(core)
             raw = g.predict_raw(np.asarray(X, np.float64))

@@ -793,8 +793,11 @@ class GBDT:
                                if self._cegb_used is not None else {})
                     if (self.config.extra_trees
                             or self.config.feature_fraction_bynode < 1.0):
+                        # continued training advances the stream instead
+                        # of replaying the first run's draws
                         grow_kw["extra_tag"] = np.int32(
-                            self.iter_ * K + k)
+                            (self.num_init_iteration_ + self.iter_) * K
+                            + k)
                     arrays, leaf_id = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,

@@ -164,6 +164,18 @@ def _need_filter(cnt_in_bin: List[int], total_cnt: int, filter_cnt: int,
         return False
 
 
+def prep_find_bin_values(col: np.ndarray) -> np.ndarray:
+    """Sample column -> the `values` array find_bin expects: non-zero
+    finite values followed by the NaNs; zeros are implied by
+    total_sample_cnt - len(values) (find_bin's contract — keep every
+    caller on this one helper so the zero/NaN sampling convention cannot
+    diverge between the single-host and distributed binning paths)."""
+    col = np.asarray(col, np.float64)
+    nonzero = col[~((col == 0) | np.isnan(col))]
+    nan_vals = col[np.isnan(col)]
+    return np.concatenate([nonzero, nan_vals])
+
+
 class BinMapper:
     """Per-feature value->bin mapping (ref: include/LightGBM/bin.h:84)."""
 

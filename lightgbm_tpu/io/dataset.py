@@ -172,9 +172,8 @@ class Dataset:
             for f in range(num_features):
                 col = sample[:, f]
                 # reference samples *non-zero* values; zeros are implied counts
-                nonzero = col[~((col == 0) | np.isnan(col))]
-                nan_vals = col[np.isnan(col)]
-                vals = np.concatenate([nonzero, nan_vals])
+                from .binning import prep_find_bin_values
+                vals = prep_find_bin_values(col)
                 mapper = BinMapper()
                 fmax_bin = (int(max_bin_by_feature[f])
                             if max_bin_by_feature else max_bin)

@@ -546,11 +546,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             sel_gain = jnp.where(st.tree.leaf_depth < params.max_depth,
                                  sel_gain, K_MIN_SCORE)
         if forced_leaf is not None:
-            # forced splits apply regardless of gain rank (ForceSplits)
+            # forced splits apply regardless of gain RANK but still
+            # respect max_depth and the leaf budget (sel_gain carries the
+            # depth mask; ForceSplits aborts past limits)
             best_leaf = jnp.asarray(forced_leaf, jnp.int32)
             proceed = jnp.logical_and(~st.done,
-                                      st.pending.gain[best_leaf]
-                                      > K_MIN_SCORE)
+                                      sel_gain[best_leaf] > K_MIN_SCORE)
+            proceed = jnp.logical_and(proceed, st.tree.num_leaves < L)
         else:
             best_leaf = jnp.argmax(sel_gain).astype(jnp.int32)
             proceed = jnp.logical_and(~st.done, sel_gain[best_leaf] > 0.0)
@@ -685,13 +687,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             else:
                 child_branch = st.leaf_branch[0]
                 leaf_branch = st.leaf_branch
+            # tag spaces: forced prologue steps use [1..2KF], the main
+            # loop [2KF+1..] — no collision between the two phases
+            tag_base = i if forced_leaf is not None else i + KF
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
                              pd.left_output[best_leaf], l_min, l_max, depth,
-                             rand_tag=2 * (i + KF) + 1, used=used_vec,
+                             rand_tag=2 * tag_base + 1, used=used_vec,
                              branch=child_branch)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
-                             depth, rand_tag=2 * (i + KF) + 2,
+                             depth, rand_tag=2 * tag_base + 2,
                              used=used_vec, branch=child_branch)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
@@ -730,7 +735,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         nb = meta.num_bin[feat]
         is_na = ((meta.missing_type[feat] == MISSING_NAN)
                  & (bins == nb - 1))
-        take = (bins <= thr) & (bins < nb) & ~is_na
+        # MISSING_ZERO rows (the default bin) route right, matching
+        # go_left_of's default_left=False partition of this split
+        is_zero = ((meta.missing_type[feat] == MISSING_ZERO)
+                   & (bins == meta.default_bin[feat]))
+        take = (bins <= thr) & (bins < nb) & ~is_na & ~is_zero
         hf = hist[feat]
         lg = jnp.sum(jnp.where(take, hf[:, 0], 0.0))
         lh_raw = jnp.sum(jnp.where(take, hf[:, 1], 0.0))
@@ -759,16 +768,23 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             cat_bitset=jnp.zeros(cat_bitset_words(B), jnp.int32))
         return st._replace(pending=_pending_set(st.pending, leaf, res))
 
+    forcing_ok = jnp.asarray(True)
     for k, (fleaf, ffeat, fthr) in enumerate(params.forced_splits):
         if k >= L - 1:
             break
         old_pending = state.pending
         old_nl = state.tree.num_leaves
         state = forced_pending(state, fleaf, ffeat, fthr)
+        # the parse-time BFS leaf numbers are only valid while every
+        # forced split applies; after the first skip, abort the rest
+        # (ForceSplits' abort semantics) by poisoning the forced gain
+        state = state._replace(pending=state.pending._replace(
+            gain=jnp.where(forcing_ok, state.pending.gain, K_MIN_SCORE)))
         state = body(k, state, forced_leaf=fleaf)
-        # a skipped forced split must not clobber the leaf's real
-        # pending entry (ForceSplits abandons forcing, growth continues)
         applied = state.tree.num_leaves > old_nl
+        forcing_ok = forcing_ok & applied
+        # a skipped forced split must not clobber the leaf's real
+        # pending entry (growth continues on real gains)
         state = state._replace(pending=jax.tree.map(
             lambda new, old: jnp.where(applied, new, old),
             state.pending, old_pending))

@@ -18,7 +18,8 @@ from typing import List, Sequence
 
 import numpy as np
 
-from ..io.binning import BIN_NUMERICAL, BinMapper
+from ..io.binning import (BIN_NUMERICAL, BinMapper,
+                          prep_find_bin_values)
 
 
 def sample_rows(X_local: np.ndarray, sample_cnt: int,
@@ -48,13 +49,8 @@ def merged_bin_mappers(local_samples: Sequence[np.ndarray],
     for f in range(merged.shape[1]):
         col = merged[:, f]
         btype = (bin_types[f] if bin_types is not None else BIN_NUMERICAL)
-        if btype == BIN_NUMERICAL:
-            # zeros are implied by total - len(vals) (find_bin contract)
-            nonzero = col[~((col == 0) | np.isnan(col))]
-            nan_vals = col[np.isnan(col)]
-            vals = np.concatenate([nonzero, nan_vals])
-        else:
-            vals = col
+        vals = (prep_find_bin_values(col) if btype == BIN_NUMERICAL
+                else col)
         m = BinMapper()
         m.find_bin(vals, total, max_bin,
                    min_data_in_bin=min_data_in_bin,

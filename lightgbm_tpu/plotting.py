@@ -171,8 +171,12 @@ def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
             return f"leaf{leaf}"
         f = int(tree.split_feature[node])
         fname = names[f] if f < len(names) else f"Column_{f}"
-        g.node(f"split{node}",
-               label=f"{fname} <= {tree.threshold[node]:.{precision}f}")
+        if tree.decision_type[node] & 1:  # categorical membership
+            cats = "||".join(str(c) for c in tree._cats_of_node(node))
+            label = f"{fname} in {{{cats}}}"
+        else:
+            label = f"{fname} <= {tree.threshold[node]:.{precision}f}"
+        g.node(f"split{node}", label=label)
         left = add(int(tree.left_child[node]))
         right = add(int(tree.right_child[node]))
         g.edge(f"split{node}", left, label="yes")
