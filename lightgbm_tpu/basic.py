@@ -71,6 +71,11 @@ class Dataset:
                 if self.feature_name == "auto":
                     self.feature_name = list(map(str, data.columns))
                 data = data.values
+            if hasattr(data, "tocsr") or hasattr(data, "toarray"):
+                # scipy CSR/CSC/COO (ref: LGBM_DatasetCreateFromCSR/CSC,
+                # c_api.h:334,416): densified — device storage is dense
+                # binned tensors and EFB re-compresses sparse columns
+                data = np.asarray(data.todense(), dtype=np.float64)
             data = np.asarray(data, dtype=np.float64)
             cat = []
             if self.categorical_feature not in ("auto", None):

@@ -80,3 +80,20 @@ def test_load_reference_example_file():
     # weight sidecar file should be auto-loaded (binary.train.weight exists)
     assert ds.metadata.weight is not None
     assert len(ds.metadata.weight) == 7000
+
+
+def test_dataset_from_scipy_sparse():
+    """CSR/CSC input (ref: LGBM_DatasetCreateFromCSR/CSC): densified into
+    the binned tensors; EFB re-compresses exclusive sparse columns."""
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    dense = rng.rand(800, 5) * (rng.rand(800, 5) < 0.3)
+    y = dense[:, 0] + dense[:, 1]
+    for mat in (sp.csr_matrix(dense), sp.csc_matrix(dense)):
+        b = lgb.train({"objective": "regression", "num_leaves": 7,
+                       "verbosity": -1, "min_data_in_leaf": 5},
+                      lgb.Dataset(mat, label=y), num_boost_round=15)
+        mse = float(np.mean((b.predict(dense) - y) ** 2))
+        var = float(np.var(y))
+        assert mse < 0.3 * var, (mse, var)
