@@ -110,3 +110,15 @@ def test_snapshot_freq(tmp_path):
     import lightgbm_tpu as lgb
     b = lgb.Booster(model_file=str(snaps[0]))
     assert b._gbdt.current_iteration() == 2
+
+
+def test_parallel_learning_example_conf(tmp_path, monkeypatch):
+    """The reference's parallel_learning config (tree_learner=feature +
+    machine list params) parses and trains; on the virtual mesh the
+    feature axis is sharded (SURVEY §2.3 #2)."""
+    ex = f"{EXAMPLES}/parallel_learning"
+    monkeypatch.chdir(ex)      # relative data paths resolve like the ref CLI
+    model = tmp_path / "model.txt"
+    rc = main(["config=train.conf", "num_iterations=2",
+               f"output_model={model}", "verbosity=-1"])
+    assert rc == 0 and model.exists()
