@@ -65,3 +65,37 @@ def test_invalid_forced_split_is_skipped(tmp_path):
     t = b._gbdt.models_[0]
     assert t.num_leaves == 8          # growth continued
     assert t.split_feature[0] != 2    # forced split was skipped
+
+
+def test_forced_abort_chain(tmp_path):
+    """Once a forced split is skipped, the remaining forced splits abort
+    (parse-time leaf numbers are stale) and best-gain growth fills the
+    budget."""
+    forced = {"feature": 2, "threshold": 99.0,            # invalid: skips
+              "left": {"feature": 1, "threshold": 0.5},   # must abort
+              "right": {"feature": 1, "threshold": 0.5}}  # must abort
+    b = _train_with_forced(tmp_path, forced, leaves=8)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 8
+    assert t.split_feature[0] != 2  # root chosen by gain, not forcing
+
+
+def test_forced_respects_max_depth(tmp_path):
+    import json
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(4)
+    X = rng.rand(2000, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(2000)
+    forced = {"feature": 2, "threshold": 0.5,
+              "left": {"feature": 1, "threshold": 0.25,
+                       "left": {"feature": 0, "threshold": 0.5}}}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(forced))
+    b = lgb.train({"objective": "regression", "num_leaves": 8,
+                   "verbosity": -1, "min_data_in_leaf": 5, "max_depth": 2,
+                   "forcedsplits_filename": str(path)},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    b._gbdt._sync_model()
+    t = b._gbdt.models_[0]
+    assert t.leaf_depth[:t.num_leaves].max() <= 2

@@ -89,3 +89,52 @@ def test_reset_parameter_callback():
     b._gbdt._sync_model()
     shr = [t.shrinkage for t in b._gbdt.models_ if t.num_leaves > 1]
     assert shr[0] > shr[-1]
+
+
+def test_eval_on_loaded_booster(tmp_path, booster):
+    """eval() must work on a predictor-mode booster loaded from file."""
+    b, X, y = booster
+    path = str(tmp_path / "m.txt")
+    b.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    res = loaded.eval(lgb.Dataset(X, label=y), "holdout")
+    assert res and res[0][0] == "holdout"
+    assert np.isfinite(res[0][2])
+    # matches the trained booster's own eval on the same data
+    res0 = b.eval(lgb.Dataset(X, label=y), "holdout2")
+    assert abs(res[0][2] - res0[0][2]) < 1e-5
+
+
+def test_model_from_string_resets_state(booster):
+    b, X, y = booster
+    s = b.model_to_string()
+    b2 = lgb.train({"objective": "regression", "num_leaves": 7,
+                    "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=2,
+                   valid_sets=[lgb.Dataset(X, label=y)],
+                   valid_names=["v"])
+    b2.model_from_string(s)
+    assert b2.name_valid_sets == []
+    assert b2.num_trees() == b.num_trees()
+    # eval_valid on the fresh model must not crash or ghost old sets
+    assert b2.eval_valid() == []
+
+
+def test_reset_parameter_rebuilds_grow_params():
+    rng = np.random.RandomState(5)
+    X = rng.randn(1200, 3)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(1200)
+    b = lgb.Booster(params={"objective": "regression", "num_leaves": 31,
+                            "verbosity": -1, "min_data_in_leaf": 5},
+                    train_set=lgb.Dataset(X, label=y))
+    for _ in range(2):
+        b.update()
+    b.reset_parameter({"min_data_in_leaf": 400})
+    assert b._gbdt.grow_params.split.min_data_in_leaf == 400
+    for _ in range(2):
+        b.update()
+    b._gbdt._sync_model()
+    trees = b._gbdt.models_
+    # later trees obey the tighter leaf-size bound
+    assert min(t.leaf_count[:t.num_leaves].min() for t in trees[2:]) >= 400
+    assert min(t.leaf_count[:t.num_leaves].min() for t in trees[:2]) < 400
