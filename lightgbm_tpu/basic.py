@@ -253,6 +253,23 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_tree_per_iteration
 
+    def __getstate__(self):
+        """Pickle via the model text (ref: basic.py Booster.__getstate__):
+        the live GBDT holds device arrays and jitted closures."""
+        state = self.__dict__.copy()
+        state.pop("_train_set", None)
+        gbdt = state.pop("_gbdt", None)
+        state["_model_str"] = (save_model_to_string(gbdt)
+                               if gbdt is not None else None)
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_model_str", None)
+        self.__dict__.update(state)
+        self._train_set = None
+        self._gbdt = (load_model_from_string(model_str)
+                      if model_str is not None else None)
+
     def model_from_string(self, model_str: str) -> "Booster":
         """Replace this booster's model (ref: basic.py model_from_string)."""
         self._gbdt = load_model_from_string(model_str)

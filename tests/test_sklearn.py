@@ -105,3 +105,21 @@ def test_ranker():
     # scores must rank relevant docs above irrelevant within queries
     corr = np.corrcoef(s, rel)[0, 1]
     assert corr > 0.5, corr
+
+
+def test_pickle_roundtrip():
+    """Boosters and sklearn estimators pickle via the model text
+    (ref: basic.py Booster.__getstate__) — required for joblib
+    persistence and sklearn model selection."""
+    import pickle
+    X, y = _cls_data(800)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=7).fit(X, y)
+    blob = pickle.dumps(clf)
+    clf2 = pickle.loads(blob)
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+    np.testing.assert_allclose(clf2.predict_proba(X),
+                               clf.predict_proba(X), rtol=1e-6)
+    # bare Booster too
+    b = clf.booster_
+    b2 = pickle.loads(pickle.dumps(b))
+    np.testing.assert_allclose(b2.predict(X), b.predict(X), rtol=1e-6)
