@@ -21,6 +21,20 @@ from .boosting.model_io import (load_model_from_file, load_model_from_string,
 from .utils import log
 
 
+def _coerce_matrix(data) -> np.ndarray:
+    """pandas / pyarrow / scipy-sparse / array-like -> float64 ndarray."""
+    if (type(data).__module__ or "").startswith("pyarrow"):
+        return np.column_stack([
+            np.asarray(data.column(i).to_numpy(zero_copy_only=False),
+                       dtype=np.float64)
+            for i in range(data.num_columns)])
+    if hasattr(data, "values"):          # pandas
+        data = data.values
+    if hasattr(data, "toarray"):         # scipy CSR/CSC/COO
+        data = data.toarray()
+    return np.asarray(data, dtype=np.float64)
+
+
 class Dataset:
     """Lazily-constructed training dataset (ref: basic.py:1555 Dataset)."""
 
@@ -56,27 +70,18 @@ class Dataset:
                 self._core.metadata.set_label(self.label)
         else:
             data = self.data
-            if (type(data).__module__ or "").startswith("pyarrow"):
-                # Arrow ingestion (ref: include/LightGBM/arrow.h;
-                # LGBM_DatasetCreateFromArrow, c_api.h:214): zero-copy-ish
-                # columnar tables/batches become the feature matrix
-                if self.feature_name == "auto" and hasattr(data,
-                                                           "column_names"):
+            # column names from pandas / arrow before coercion
+            if self.feature_name == "auto":
+                if (type(data).__module__ or "").startswith("pyarrow") \
+                        and hasattr(data, "column_names"):
                     self.feature_name = list(data.column_names)
-                data = np.column_stack([
-                    np.asarray(data.column(i).to_numpy(
-                        zero_copy_only=False), dtype=np.float64)
-                    for i in range(data.num_columns)])
-            if hasattr(data, "values"):  # pandas
-                if self.feature_name == "auto":
+                elif hasattr(data, "columns"):
                     self.feature_name = list(map(str, data.columns))
-                data = data.values
-            if hasattr(data, "tocsr") or hasattr(data, "toarray"):
-                # scipy CSR/CSC/COO (ref: LGBM_DatasetCreateFromCSR/CSC,
-                # c_api.h:334,416): densified — device storage is dense
-                # binned tensors and EFB re-compresses sparse columns
-                data = np.asarray(data.todense(), dtype=np.float64)
-            data = np.asarray(data, dtype=np.float64)
+            # Arrow (arrow.h; LGBM_DatasetCreateFromArrow), pandas, and
+            # scipy CSR/CSC/COO (LGBM_DatasetCreateFromCSR/CSC) inputs are
+            # densified — device storage is dense binned tensors and EFB
+            # re-compresses exclusive sparse columns
+            data = _coerce_matrix(data)
             cat = []
             if self.categorical_feature not in ("auto", None):
                 for c in self.categorical_feature:
@@ -267,6 +272,8 @@ class Booster:
         model_str = state.pop("_model_str", None)
         self.__dict__.update(state)
         self._train_set = None
+        # the restored GBDT is predictor-mode: no valid-set machinery
+        self.name_valid_sets = []
         self._gbdt = (load_model_from_string(model_str)
                       if model_str is not None else None)
 
@@ -486,8 +493,7 @@ class Booster:
     def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
-        if hasattr(data, "values"):
-            data = data.values
+        data = _coerce_matrix(data)
         if num_iteration is None:
             num_iteration = -1
         if self.best_iteration > 0 and num_iteration == -1:
@@ -510,8 +516,7 @@ class Booster:
     def refit(self, data, label, weight=None, **kwargs) -> "Booster":
         """Refit existing tree structures to new data (ref: basic.py
         Booster.refit -> LGBM_BoosterRefit; gbdt.cpp:252 RefitTree)."""
-        if hasattr(data, "values"):
-            data = data.values
+        data = _coerce_matrix(data)
         self._gbdt.refit(np.asarray(data, np.float64),
                          np.asarray(label, np.float64), weight=weight)
         return self

@@ -94,6 +94,9 @@ def test_dataset_from_scipy_sparse():
         b = lgb.train({"objective": "regression", "num_leaves": 7,
                        "verbosity": -1, "min_data_in_leaf": 5},
                       lgb.Dataset(mat, label=y), num_boost_round=15)
+        # predict accepts sparse input too (train-CSR/predict-CSR flow)
+        np.testing.assert_allclose(b.predict(mat), b.predict(dense),
+                                   rtol=1e-9)
         mse = float(np.mean((b.predict(dense) - y) ** 2))
         var = float(np.var(y))
         assert mse < 0.3 * var, (mse, var)

@@ -123,3 +123,18 @@ def test_pickle_roundtrip():
     b = clf.booster_
     b2 = pickle.loads(pickle.dumps(b))
     np.testing.assert_allclose(b2.predict(X), b.predict(X), rtol=1e-6)
+
+
+def test_pickled_booster_eval_valid_safe():
+    """eval_valid on an unpickled booster must not ghost old valid sets."""
+    import pickle
+    X, y = _cls_data(600)
+    b = lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1},
+                  lgb.Dataset(X[:400], label=y[:400]), num_boost_round=3,
+                  valid_sets=[lgb.Dataset(X[400:], label=y[400:])],
+                  valid_names=["v"])
+    b2 = pickle.loads(pickle.dumps(b))
+    assert b2.eval_valid() == []
+    res = b2.eval(lgb.Dataset(X, label=y), "new")
+    assert res and np.isfinite(res[0][2])
