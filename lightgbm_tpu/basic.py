@@ -516,8 +516,7 @@ class Booster:
     def refit(self, data, label, weight=None, **kwargs) -> "Booster":
         """Refit existing tree structures to new data (ref: basic.py
         Booster.refit -> LGBM_BoosterRefit; gbdt.cpp:252 RefitTree)."""
-        data = _coerce_matrix(data)
-        self._gbdt.refit(np.asarray(data, np.float64),
+        self._gbdt.refit(_coerce_matrix(data),
                          np.asarray(label, np.float64), weight=weight)
         return self
 

@@ -133,12 +133,14 @@ class GBDT:
         on the replicated histogram, replacing SyncUpGlobalBestSplit.
         tree_learner=feature shards the FEATURE axis of the binned matrix
         (feature_parallel_tree_learner.cpp:23): each device scans its feature
-        block and the argmax all-gathers the winner.  voting maps to data —
-        PV-Tree's top-k vote exists to cut slow-ethernet histogram traffic,
-        which ICI makes unnecessary (SURVEY §2.3.4)."""
+        block and the argmax all-gathers the winner.  voting is data-parallel
+        with the PV-Tree top-k vote: per-leaf scans elect ~top_k features
+        and reduce only those histograms over the mesh
+        (voting_parallel_tree_learner.cpp:151 GlobalVoting)."""
         tl = config.tree_learner
         if tl not in ("serial", "data", "feature", "voting"):
             log.fatal(f"Unknown tree_learner {tl!r}")
+        self._voting = tl == "voting"
         if tl == "serial":
             return None
         ndev = len(jax.devices())
@@ -153,12 +155,8 @@ class GBDT:
             while n_mesh > 1 and F % n_mesh != 0:
                 n_mesh -= 1
         if n_mesh <= 1:
+            self._voting = False
             return None
-        if tl == "voting":
-            log.warning("tree_learner=voting maps to the data-parallel mesh "
-                        "on TPU (ICI bandwidth makes the PV-Tree vote "
-                        "unnecessary)")
-            tl = "data"
         from ..parallel import make_mesh
         self._mesh_axis = 1 if tl in ("data", "voting") else 0
         return make_mesh(n_mesh)
@@ -209,7 +207,12 @@ class GBDT:
         # a device-layout optimization — host paths (prediction, leaf ids,
         # model IO) keep per-feature bins.
         self.bundle_plan = None
-        if config.enable_bundle and train_data.num_features > 1:
+        # the PV-Tree vote is per-feature, so EFB is skipped only when
+        # voting will actually engage (a >1-device mesh exists)
+        voting_engages = (config.tree_learner == "voting"
+                          and len(jax.devices()) > 1)
+        if (config.enable_bundle and train_data.num_features > 1
+                and not voting_engages):
             from ..io.bundle import build_bundled, plan_bundles
             plan = plan_bundles(binned, train_data.bin_mappers,
                                 train_data.used_features,
@@ -345,6 +348,21 @@ class GBDT:
             from ..parallel import grow_params_for_mesh
             self.grow_params = grow_params_for_mesh(
                 self.grow_params)._replace(hist_method="segment")
+            if self._voting:
+                # PV-Tree vote (ref: voting_parallel_tree_learner.cpp):
+                # children rebuilt per scan (elected feature sets differ
+                # between parent and children, so subtraction is invalid)
+                from ..parallel.voting import VotingSpec
+                if config.forcedsplits_filename:
+                    log.fatal("tree_learner=voting does not support "
+                              "forced splits")
+                if config.top_k <= 0:
+                    log.fatal("top_k should be greater than 0 "
+                              "(ref: config.cpp CHECK_GT(top_k, 0))")
+                self.grow_params = self.grow_params._replace(
+                    use_hist_stack=False,
+                    voting=VotingSpec(self.mesh, min(config.top_k, len(nb)),
+                                      int(self.mesh.devices.size)))
         # forced splits (ref: serial_tree_learner.cpp:614 ForceSplits):
         # parse the BFS JSON into static (leaf, inner_feature, bin) tuples
         # using our split numbering (left child keeps the leaf index,
@@ -406,10 +424,12 @@ class GBDT:
                     sets.append(idxs)
             self.grow_params = self.grow_params._replace(
                 interaction_sets=tuple(sets))
-        if self.grow_params.forced_splits or self.grow_params.interaction_sets:
+        if (self.grow_params.forced_splits
+                or self.grow_params.interaction_sets
+                or self.grow_params.voting is not None):
             if strategy == "wave":
-                log.warning("forced splits / interaction constraints use "
-                            "the leaf-wise engine")
+                log.warning("forced splits / interaction constraints / "
+                            "voting use the leaf-wise engine")
             strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"

@@ -95,6 +95,11 @@ class GrowParams(NamedTuple):
     # each leaf scan draws a fresh feature subset of this fraction
     feature_fraction_bynode: float = 1.0
     bynode_seed: int = 2
+    # voting-parallel (PV-Tree, ref: voting_parallel_tree_learner.cpp):
+    # a parallel.voting.VotingSpec; per-leaf scans vote on top-k features
+    # and reduce only the elected histograms across the mesh.  Requires
+    # the masked engine (compact_min=0), no hist stack, no bundles.
+    voting: object = None
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -317,13 +322,28 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return jnp.clip((u * span).astype(jnp.int32), 0,
                         jnp.maximum(meta.num_bin - 3, 0)).astype(jnp.int32)
 
+    use_voting = params.voting is not None
+    if use_voting:
+        assert params.compact_min == 0 and not params.use_hist_stack \
+            and not params.has_bundles and not params.forced_splits, \
+            "voting-parallel needs the masked engine without hist stack/EFB"
+        from ..parallel.voting import voting_hist_elect
+
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
-                depth=None, rand_tag=0, used=None, branch=None):
+                depth=None, rand_tag=0, used=None, branch=None,
+                member_mask=None):
         cm = col_mask
         if params.interaction_sets:
             cm = cm & allowed_of(branch)
         if use_bynode:
             cm = cm & _bynode_mask(rand_tag)
+        if use_voting:
+            # PV-Tree: vote + reduce only the elected features' histograms
+            # (hist arg is ignored; the voted one is exact where elected)
+            hist, elected = voting_hist_elect(
+                binned, gh, member_mask, cm, parent_out, meta,
+                params.voting, sp, hist_B, params.hist_method)
+            cm = cm & elected
         kw = {}
         if sp.has_monotone:
             kw = dict(monotone=meta.monotone, constraint_min=cmin,
@@ -382,7 +402,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
     cnt0 = jnp.sum(row_mask.astype(jnp.int32))
-    root_hist = hist_of(ones_mask)
+    root_hist = None if use_voting else hist_of(ones_mask)
     inf = jnp.asarray(jnp.inf, f32)
     if cegb_used is None:
         cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
@@ -391,7 +411,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     root_best = best_of(root_hist, sum_g0, sum_h0, cnt0,
                         jnp.asarray(0.0, f32), -inf, inf,
                         jnp.asarray(0, jnp.int32), rand_tag=0,
-                        used=cegb_used, branch=branch0[0])
+                        used=cegb_used, branch=branch0[0],
+                        member_mask=row_mask)
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -633,11 +654,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 hist_stack = (st.hist_stack.at[best_leaf].set(hist_l)
                               .at[new_leaf].set(hist_r))
             else:
-                # rebuild both children (memory-constrained mode)
+                # rebuild both children (memory-constrained / voting mode)
                 lmaskf = (leaf_id == best_leaf).astype(f32) * row_mask
                 rmaskf = (leaf_id == new_leaf).astype(f32) * row_mask
-                hist_l = hist_of(lmaskf)
-                hist_r = hist_of(rmaskf)
+                if use_voting:  # best_of builds the voted hists itself
+                    hist_l = hist_r = None
+                else:
+                    hist_l = hist_of(lmaskf)
+                    hist_r = hist_of(rmaskf)
                 hist_stack = st.hist_stack
 
             # --- monotone constraint propagation (basic mode, ref:
@@ -693,11 +717,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
                              pd.left_output[best_leaf], l_min, l_max, depth,
                              rand_tag=2 * tag_base + 1, used=used_vec,
-                             branch=child_branch)
+                             branch=child_branch,
+                             member_mask=lmaskf if use_voting else None)
             best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                              pd.right_output[best_leaf], r_min, r_max,
                              depth, rand_tag=2 * tag_base + 2,
-                             used=used_vec, branch=child_branch)
+                             used=used_vec, branch=child_branch,
+                             member_mask=rmaskf if use_voting else None)
             pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                    new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,

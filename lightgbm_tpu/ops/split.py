@@ -269,7 +269,8 @@ def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
             so_i, used_bin, order)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(jax.jit,
+                   static_argnames=("params", "return_feature_gains"))
 def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     missing_type: jnp.ndarray, default_bin: jnp.ndarray,
                     feature_penalty: jnp.ndarray, col_mask: jnp.ndarray,
@@ -283,7 +284,8 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     monotone: jnp.ndarray = None,
                     constraint_min: jnp.ndarray = None,
                     constraint_max: jnp.ndarray = None,
-                    mono_penalty: jnp.ndarray = None) -> SplitResult:
+                    mono_penalty: jnp.ndarray = None,
+                    return_feature_gains: bool = False) -> SplitResult:
     """Scan all (feature, threshold, direction) candidates; return the leaf's best.
 
     Args:
@@ -448,6 +450,11 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         # (serial_tree_learner.cpp:987-991)
         shifted = jnp.where(monotone != 0, shifted * mono_penalty, shifted)
     shifted = jnp.where(col_mask & (best_gain_f > K_MIN_SCORE), shifted, K_MIN_SCORE)
+    if return_feature_gains:
+        # per-feature shifted best gains, for the voting-parallel learner's
+        # local vote (ref: voting_parallel_tree_learner.cpp:151 GlobalVoting
+        # ranks features by their local best split gains)
+        return shifted
     best_f = jnp.argmax(shifted, axis=0).astype(jnp.int32)
 
     g_ = shifted[best_f]
