@@ -263,11 +263,19 @@ class GBDT:
                 "basic", "intermediate", "advanced"):
             log.fatal("Unknown monotone_constraints_method "
                       f"{config.monotone_constraints_method!r}")
+        self._mono_intermediate = False
         if has_mono and config.monotone_constraints_method != "basic":
-            log.warning(f"monotone_constraints_method="
-                        f"{config.monotone_constraints_method} falls back "
-                        "to basic on TPU (slack propagation across leaves "
-                        "is inherently sequential)")
+            if config.monotone_constraints_method == "advanced":
+                log.warning("monotone_constraints_method=advanced maps to "
+                            "intermediate on TPU (per-feature slack "
+                            "recomputation is inherently sequential)")
+            if config.extra_trees or config.feature_fraction_bynode < 1.0:
+                log.warning("monotone_constraints_method=intermediate "
+                            "falls back to basic with extra_trees / "
+                            "feature_fraction_bynode (the full-tree "
+                            "pending rescan has no per-leaf random state)")
+            else:
+                self._mono_intermediate = True
         # CEGB (ref: cost_effective_gradient_boosting.hpp IsEnable)
         has_cegb = (config.cegb_tradeoff < 1.0
                     or config.cegb_penalty_split > 0.0
@@ -333,6 +341,7 @@ class GBDT:
                            else int(bp.group_num_bin.max())),
             feature_fraction_bynode=config.feature_fraction_bynode,
             bynode_seed=config.feature_fraction_seed + 1,
+            monotone_intermediate=self._mono_intermediate,
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -341,6 +350,13 @@ class GBDT:
             # (ref: gpu_tree_learner.h:79 single-precision default).
             hist_method=(("onehot_hp" if config.gpu_use_dp else "pallas")
                          if jax.default_backend() == "tpu" else "segment"))
+        if (self.grow_params.monotone_intermediate
+                and not self.grow_params.use_hist_stack):
+            log.warning("monotone intermediate mode needs the per-leaf "
+                        "histogram stack (histogram_pool_size); falling "
+                        "back to basic")
+            self.grow_params = self.grow_params._replace(
+                monotone_intermediate=False)
         if self.mesh is not None and self._mesh_axis == 1:
             # row sharding: masked engine (global-index row gathers would
             # all-gather the binned matrix) + XLA histogram (GSPMD cannot
@@ -359,8 +375,12 @@ class GBDT:
                 if config.top_k <= 0:
                     log.fatal("top_k should be greater than 0 "
                               "(ref: config.cpp CHECK_GT(top_k, 0))")
+                if self.grow_params.monotone_intermediate:
+                    log.warning("monotone intermediate mode falls back to "
+                                "basic under tree_learner=voting (no "
+                                "histogram stack to rescan)")
                 self.grow_params = self.grow_params._replace(
-                    use_hist_stack=False,
+                    use_hist_stack=False, monotone_intermediate=False,
                     voting=VotingSpec(self.mesh, min(config.top_k, len(nb)),
                                       int(self.mesh.devices.size)))
         # forced splits (ref: serial_tree_learner.cpp:614 ForceSplits):
@@ -426,10 +446,12 @@ class GBDT:
                 interaction_sets=tuple(sets))
         if (self.grow_params.forced_splits
                 or self.grow_params.interaction_sets
-                or self.grow_params.voting is not None):
+                or self.grow_params.voting is not None
+                or self.grow_params.monotone_intermediate):
             if strategy == "wave":
                 log.warning("forced splits / interaction constraints / "
-                            "voting use the leaf-wise engine")
+                            "voting / intermediate monotone mode use the "
+                            "leaf-wise engine")
             strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"

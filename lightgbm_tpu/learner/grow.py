@@ -100,6 +100,13 @@ class GrowParams(NamedTuple):
     # and reduce only the elected histograms across the mesh.  Requires
     # the masked engine (compact_min=0), no hist stack, no bundles.
     voting: object = None
+    # monotone_constraints_method=intermediate (ref:
+    # monotone_constraints.hpp:516 IntermediateLeafConstraints): leaf
+    # hyper-rectangles in bin space + a pairwise constraint recompute and
+    # full pending rescan after every split replace the reference's
+    # recursive GoUp/GoDownToFindLeavesToUpdate crawl.  Requires the
+    # hist stack; incompatible with extra_trees / bynode sampling.
+    monotone_intermediate: bool = False
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -185,6 +192,8 @@ class _State(NamedTuple):
     cegb_used: jnp.ndarray      # [F] bool coupled-penalty paid (or [1])
     leaf_branch: jnp.ndarray    # [L, F] branch features (or [1, 1])
     done: jnp.ndarray           # scalar bool
+    leaf_lo: jnp.ndarray = None  # [L, F] bin-space rect lower bounds
+    leaf_hi: jnp.ndarray = None  # [L, F] rect upper bounds (exclusive)
 
 
 def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
@@ -329,6 +338,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             "voting-parallel needs the masked engine without hist stack/EFB"
         from ..parallel.voting import voting_hist_elect
 
+    use_intermediate = params.monotone_intermediate and sp.has_monotone
+    if use_intermediate:
+        assert params.use_hist_stack and not sp.extra_trees \
+            and not use_bynode and not use_voting, \
+            "intermediate monotone mode needs the hist stack and fixed " \
+            "per-leaf scans (no extra_trees / bynode sampling / voting)"
+
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
                 depth=None, rand_tag=0, used=None, branch=None,
                 member_mask=None):
@@ -461,6 +477,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_start0 = jnp.zeros(1, jnp.int32)
         leaf_seg_cnt0 = jnp.zeros(1, jnp.int32)
 
+    if use_intermediate:
+        # leaf hyper-rectangles in bin space (root covers every bin)
+        leaf_lo0 = jnp.zeros((L, num_features), jnp.int32)
+        leaf_hi0 = jnp.broadcast_to(meta.num_bin[None, :],
+                                    (L, num_features)).astype(jnp.int32)
+    else:
+        leaf_lo0 = leaf_hi0 = jnp.zeros((1, 1), jnp.int32)
     state = _State(tree=tree, pending=pending,
                    leaf_id=jnp.zeros(n, jnp.int32), hist_stack=hist_stack,
                    leaf_sum_g=jnp.zeros(L, f32).at[0].set(sum_g0),
@@ -473,7 +496,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                       f32),
                    cegb_used=cegb_used,
                    leaf_branch=branch0,
-                   done=jnp.asarray(False))
+                   done=jnp.asarray(False),
+                   leaf_lo=leaf_lo0, leaf_hi=leaf_hi0)
 
     def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
                            isc, bitset):
@@ -668,7 +692,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # monotone_constraints.hpp:489 BasicLeafConstraints::Update:
             # the new leaf clones the parent entry, then a numerical split
             # on a monotone feature bounds both children at the midpoint)
-            if sp.has_monotone:
+            if sp.has_monotone and not use_intermediate:
                 p_min = st.leaf_cmin[best_leaf]
                 p_max = st.leaf_cmax[best_leaf]
                 mc_w = meta.monotone[feat]
@@ -711,33 +735,111 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             else:
                 child_branch = st.leaf_branch[0]
                 leaf_branch = st.leaf_branch
-            # tag spaces: forced prologue steps use [1..2KF], the main
-            # loop [2KF+1..] — no collision between the two phases
-            tag_base = i if forced_leaf is not None else i + KF
-            best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
-                             pd.left_output[best_leaf], l_min, l_max, depth,
-                             rand_tag=2 * tag_base + 1, used=used_vec,
-                             branch=child_branch,
-                             member_mask=lmaskf if use_voting else None)
-            best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
-                             pd.right_output[best_leaf], r_min, r_max,
-                             depth, rand_tag=2 * tag_base + 2,
-                             used=used_vec, branch=child_branch,
-                             member_mask=rmaskf if use_voting else None)
-            pending = _pending_set(_pending_set(pd, best_leaf, best_l),
-                                   new_leaf, best_r)
+            new_sum_g = (st.leaf_sum_g.at[best_leaf].set(lsum_g)
+                         .at[new_leaf].set(rsum_g))
+            new_sum_h = (st.leaf_sum_h.at[best_leaf].set(lsum_h)
+                         .at[new_leaf].set(rsum_h))
+
+            if use_intermediate:
+                # --- intermediate mode (ref: monotone_constraints.hpp:516
+                # IntermediateLeafConstraints).  TPU redesign: instead of
+                # the recursive GoUp/GoDownToFindLeavesToUpdate crawl that
+                # finds contiguous leaves and re-finds their splits one by
+                # one, track each leaf's bin-space hyper-rectangle, derive
+                # every leaf's [min, max] from the pairwise contiguity
+                # relation in one vectorized pass, and re-scan ALL leaves'
+                # pending splits from the histogram stack (vmapped) —
+                # exactly consistent constraints after every split.
+                fvec = jnp.arange(num_features, dtype=jnp.int32) == feat
+                lo_s = st.leaf_lo[best_leaf]
+                hi_s = st.leaf_hi[best_leaf]
+                cut = (thr + 1).astype(jnp.int32)
+                narrow = fvec & ~isc   # categorical splits don't narrow
+                # left child keeps the best_leaf slot ([lo, cut) along
+                # feat); the right child inherits the parent rect with
+                # lo_feat = cut
+                leaf_lo = (st.leaf_lo
+                           .at[new_leaf].set(jnp.where(narrow, cut, lo_s)))
+                leaf_hi = (st.leaf_hi
+                           .at[new_leaf].set(hi_s)
+                           .at[best_leaf].set(jnp.where(narrow, cut, hi_s)))
+                out = tree.leaf_value
+                alive = jnp.arange(L, dtype=jnp.int32) < tree.num_leaves
+                # [L, L, F]: do rects i and j overlap along f?
+                ov = ((leaf_lo[:, None, :] < leaf_hi[None, :, :])
+                      & (leaf_lo[None, :, :] < leaf_hi[:, None, :]))
+                nov = (~ov).astype(jnp.int32)
+                n_false = jnp.sum(nov, axis=2)
+                # overlap in every feature except f (contiguity slice)
+                exc = (n_false[:, :, None] - nov) == 0
+                below = leaf_hi[:, None, :] <= leaf_lo[None, :, :]
+                belowT = jnp.swapaxes(below, 0, 1)
+                incf = (meta.monotone > 0)[None, None, :]
+                decf = (meta.monotone < 0)[None, None, :]
+                valid = (alive[None, :, None] & exc
+                         & ~jnp.eye(L, dtype=bool)[:, :, None])
+                # j's output upper-bounds i when j sits on i's increasing
+                # side of an increasing feature (or decreasing side of a
+                # decreasing one); lower bounds mirror it
+                ubm = valid & ((below & incf) | (belowT & decf))
+                lbm = valid & ((belowT & incf) | (below & decf))
+                outj = out[None, :, None]
+                leaf_cmax = jnp.min(jnp.where(ubm, outj, jnp.inf),
+                                    axis=(1, 2))
+                leaf_cmin = jnp.max(jnp.where(lbm, outj, -jnp.inf),
+                                    axis=(1, 2))
+                branch_all = (leaf_branch if params.interaction_sets
+                              else jnp.zeros((L, 1), bool))
+
+                def _rescan(h, sg, sh, c, po, mn, mx, d, br):
+                    return best_of(h, sg, sh, c, po, mn, mx, d,
+                                   rand_tag=0, used=used_vec, branch=br)
+
+                res = jax.vmap(_rescan)(
+                    hist_stack, new_sum_g, new_sum_h, tree.leaf_count,
+                    tree.leaf_value, leaf_cmin, leaf_cmax, tree.leaf_depth,
+                    branch_all)
+                pending = _PendingSplits(
+                    gain=jnp.where(alive, res.gain, K_MIN_SCORE),
+                    feature=res.feature, threshold=res.threshold,
+                    default_left=res.default_left,
+                    left_sum_gradient=res.left_sum_gradient,
+                    left_sum_hessian=res.left_sum_hessian,
+                    left_count=res.left_count,
+                    left_output=res.left_output,
+                    right_sum_gradient=res.right_sum_gradient,
+                    right_sum_hessian=res.right_sum_hessian,
+                    right_count=res.right_count,
+                    right_output=res.right_output,
+                    is_cat=res.is_cat, cat_bitset=res.cat_bitset)
+            else:
+                leaf_lo, leaf_hi = st.leaf_lo, st.leaf_hi
+                # tag spaces: forced prologue steps use [1..2KF], the main
+                # loop [2KF+1..] — no collision between the two phases
+                tag_base = i if forced_leaf is not None else i + KF
+                best_l = best_of(hist_l, lsum_g, lsum_h, cnt_l,
+                                 pd.left_output[best_leaf], l_min, l_max,
+                                 depth, rand_tag=2 * tag_base + 1,
+                                 used=used_vec, branch=child_branch,
+                                 member_mask=lmaskf if use_voting else None)
+                best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
+                                 pd.right_output[best_leaf], r_min, r_max,
+                                 depth, rand_tag=2 * tag_base + 2,
+                                 used=used_vec, branch=child_branch,
+                                 member_mask=rmaskf if use_voting else None)
+                pending = _pending_set(_pending_set(pd, best_leaf, best_l),
+                                       new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
                           hist_stack=hist_stack,
-                          leaf_sum_g=st.leaf_sum_g.at[best_leaf].set(lsum_g)
-                                                  .at[new_leaf].set(rsum_g),
-                          leaf_sum_h=st.leaf_sum_h.at[best_leaf].set(lsum_h)
-                                                  .at[new_leaf].set(rsum_h),
+                          leaf_sum_g=new_sum_g,
+                          leaf_sum_h=new_sum_h,
                           order=order, leaf_start=leaf_start,
                           leaf_seg_cnt=leaf_seg_cnt,
                           leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
                           cegb_used=used_vec,
                           leaf_branch=leaf_branch,
-                          done=st.done)
+                          done=st.done,
+                          leaf_lo=leaf_lo, leaf_hi=leaf_hi)
 
         if forced_leaf is not None:
             # an invalid forced split (empty child) is skipped; growth

@@ -91,3 +91,52 @@ def test_monotone_with_alias_param():
                          "min_data_in_leaf": 5},
                         lgb.Dataset(X, label=y), num_boost_round=5)
     assert _is_monotone(booster, 0, +1)
+
+
+def test_intermediate_mode_monotone_and_tighter_fit():
+    """monotone_constraints_method=intermediate (ref:
+    monotone_constraints.hpp:516 IntermediateLeafConstraints): output-based
+    constraints are looser than basic's midpoints, so the fit improves, and
+    the vectorized pairwise recompute keeps predictions monotone on every
+    feature slice."""
+    X, y = _problem()
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "learning_rate": 0.2, "min_data_in_leaf": 5,
+            "monotone_constraints": [1, -1, 0],
+            "tpu_growth_strategy": "leafwise"}
+    b_basic = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=20)
+    b_int = lgb.train({**base, "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=20)
+    # monotone on randomized slices of the other features
+    rng = np.random.RandomState(11)
+    for _ in range(10):
+        others = tuple(rng.rand(2))
+        assert _is_monotone(b_int, 0, +1, others)
+        assert _is_monotone(b_int, 1, -1, others)
+    mse_basic = float(np.mean((b_basic.predict(X) - y) ** 2))
+    mse_int = float(np.mean((b_int.predict(X) - y) ** 2))
+    assert mse_int <= mse_basic * 1.02, (mse_int, mse_basic)
+
+
+def test_advanced_mode_maps_to_intermediate():
+    X, y = _problem(n=1500)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "monotone_constraints": [1, -1, 0],
+                   "monotone_constraints_method": "advanced",
+                   "tpu_growth_strategy": "leafwise"},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    assert b._gbdt.grow_params.monotone_intermediate
+    assert _is_monotone(b, 0, +1)
+    assert _is_monotone(b, 1, -1)
+
+
+def test_intermediate_falls_back_with_extra_trees():
+    X, y = _problem(n=1500)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "monotone_constraints": [1, 0, 0], "extra_trees": True,
+                   "monotone_constraints_method": "intermediate"},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    assert not b._gbdt.grow_params.monotone_intermediate
+    assert _is_monotone(b, 0, +1)
