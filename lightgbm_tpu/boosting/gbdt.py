@@ -277,13 +277,19 @@ class GBDT:
             else:
                 self._mono_intermediate = True
         # CEGB (ref: cost_effective_gradient_boosting.hpp IsEnable)
+        has_lazy = bool(config.cegb_penalty_feature_lazy)
         has_cegb = (config.cegb_tradeoff < 1.0
                     or config.cegb_penalty_split > 0.0
-                    or bool(config.cegb_penalty_feature_coupled))
-        if config.cegb_penalty_feature_lazy:
-            log.warning("cegb_penalty_feature_lazy is not supported on TPU "
-                        "(needs a per-(row, feature) usage bitset); "
-                        "ignoring it")
+                    or bool(config.cegb_penalty_feature_coupled)
+                    or has_lazy)
+        lazy = np.zeros(len(nb), np.float32)
+        if has_lazy:
+            lz = list(config.cegb_penalty_feature_lazy)
+            if len(lz) != train_data.num_total_features:
+                log.fatal("cegb_penalty_feature_lazy should be the same "
+                          "size as feature number.")
+            for i, f in enumerate(train_data.used_features):
+                lazy[i] = lz[f]
         coupled = np.zeros(len(nb), np.float32)
         if config.cegb_penalty_feature_coupled:
             cp = list(config.cegb_penalty_feature_coupled)
@@ -293,6 +299,18 @@ class GBDT:
             for i, f in enumerate(train_data.used_features):
                 coupled[i] = cp[f]
         self._cegb_used = (jnp.zeros(len(nb), bool) if has_cegb else None)
+        if has_lazy and self._mono_intermediate:
+            log.warning("monotone intermediate mode falls back to basic "
+                        "with cegb_penalty_feature_lazy")
+            self._mono_intermediate = False
+        if has_lazy and self._voting:
+            log.fatal("cegb_penalty_feature_lazy is not supported with "
+                      "tree_learner=voting")
+        # per-(feature, row) fetched bitset, persistent across trees
+        # (ref: cost_effective_gradient_boosting.hpp:63 feature_used_in_data_)
+        self._lazy_used = (self._put_by_row(
+            np.zeros((len(nb), self.n_pad), bool), axis=1)
+            if has_lazy else None)
         bp = self.bundle_plan
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(self.f_num_bin),
@@ -302,6 +320,7 @@ class GBDT:
             is_cat=jnp.asarray(self.f_is_cat),
             monotone=jnp.asarray(mono),
             cegb_coupled=jnp.asarray(coupled),
+            cegb_lazy=jnp.asarray(lazy),
             group=None if bp is None else jnp.asarray(bp.group_idx),
             offset=None if bp is None else jnp.asarray(bp.offsets),
             zero_bin=None if bp is None else jnp.asarray(bp.zero_bin),
@@ -335,7 +354,8 @@ class GBDT:
                 extra_seed=config.extra_seed,
                 has_cegb=has_cegb,
                 cegb_tradeoff=config.cegb_tradeoff,
-                cegb_penalty_split=config.cegb_penalty_split),
+                cegb_penalty_split=config.cegb_penalty_split,
+                has_cegb_lazy=has_lazy),
             has_bundles=bp is not None,
             group_max_bin=(0 if bp is None
                            else int(bp.group_num_bin.max())),
@@ -447,7 +467,8 @@ class GBDT:
         if (self.grow_params.forced_splits
                 or self.grow_params.interaction_sets
                 or self.grow_params.voting is not None
-                or self.grow_params.monotone_intermediate):
+                or self.grow_params.monotone_intermediate
+                or self.grow_params.split.has_cegb_lazy):
             if strategy == "wave":
                 log.warning("forced splits / interaction constraints / "
                             "voting / intermediate monotone mode use the "
@@ -840,10 +861,16 @@ class GBDT:
                         grow_kw["extra_tag"] = np.int32(
                             (self.num_init_iteration_ + self.iter_) * K
                             + k)
-                    arrays, leaf_id = self._grow_fn(
+                    if self._lazy_used is not None:
+                        grow_kw["lazy_used"] = self._lazy_used
+                    out = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,
                         **grow_kw)
+                    if self._lazy_used is not None:
+                        arrays, leaf_id, self._lazy_used = out
+                    else:
+                        arrays, leaf_id = out
                 if self._cegb_used is not None:
                     self._cegb_used = self._cegb_mark_fn(
                         self._cegb_used, arrays.split_feature,

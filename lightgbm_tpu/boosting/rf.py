@@ -80,10 +80,24 @@ class RF(GBDT):
             tree = None
             leaf_id = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
-                arrays, leaf_id = self._grow_fn(
+                grow_kw = {}
+                if self._cegb_used is not None:
+                    grow_kw["cegb_used"] = self._cegb_used
+                if self._lazy_used is not None:
+                    grow_kw["lazy_used"] = self._lazy_used
+                out = self._grow_fn(
                     self.binned_dev, self._slice_row_fn(grad, k),
                     self._slice_row_fn(hess, k), bag_mask,
-                    self._col_mask(), self.meta, self.grow_params)
+                    self._col_mask(), self.meta, self.grow_params,
+                    **grow_kw)
+                if self._lazy_used is not None:
+                    arrays, leaf_id, self._lazy_used = out
+                else:
+                    arrays, leaf_id = out
+                if self._cegb_used is not None:
+                    self._cegb_used = self._cegb_mark_fn(
+                        self._cegb_used, arrays.split_feature,
+                        arrays.num_leaves)
                 tree = self._arrays_to_tree(arrays)
             if tree is not None:
                 nl = tree.num_leaves

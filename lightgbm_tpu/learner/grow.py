@@ -56,6 +56,7 @@ class FeatureMeta(NamedTuple):
     is_cat: jnp.ndarray = None  # [F] bool (None when no categorical)
     monotone: jnp.ndarray = None  # [F] int32 -1/0/+1 (None when unused)
     cegb_coupled: jnp.ndarray = None  # [F] float32 coupled penalties
+    cegb_lazy: jnp.ndarray = None     # [F] float32 lazy per-row penalties
     # EFB (ref: feature_group.h): feature -> bundle column, code offset,
     # default (zero) bin, membership flag (None/unused when not bundling)
     group: jnp.ndarray = None       # [F] int32 bundle column index
@@ -194,6 +195,7 @@ class _State(NamedTuple):
     done: jnp.ndarray           # scalar bool
     leaf_lo: jnp.ndarray = None  # [L, F] bin-space rect lower bounds
     leaf_hi: jnp.ndarray = None  # [L, F] rect upper bounds (exclusive)
+    lazy_used: jnp.ndarray = None  # [F, n] bool rows already charged
 
 
 def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
@@ -218,7 +220,8 @@ def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               row_mask: jnp.ndarray, col_mask: jnp.ndarray, meta: FeatureMeta,
               params: GrowParams, cegb_used: jnp.ndarray = None,
-              extra_tag: jnp.ndarray = None):
+              extra_tag: jnp.ndarray = None,
+              lazy_used: jnp.ndarray = None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -345,9 +348,17 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             "intermediate monotone mode needs the hist stack and fixed " \
             "per-leaf scans (no extra_trees / bynode sampling / voting)"
 
+    use_lazy = sp.has_cegb_lazy
+    if use_lazy:
+        assert not use_voting and not use_intermediate, \
+            "cegb_penalty_feature_lazy composes with neither voting nor " \
+            "intermediate monotone mode"
+        if lazy_used is None:
+            lazy_used = jnp.zeros((num_features, n), bool)
+
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
                 depth=None, rand_tag=0, used=None, branch=None,
-                member_mask=None):
+                member_mask=None, lazy_mask=None, lazy_used_cur=None):
         cm = col_mask
         if params.interaction_sets:
             cm = cm & allowed_of(branch)
@@ -360,9 +371,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 binned, gh, member_mask, cm, parent_out, meta,
                 params.voting, sp, hist_B, params.hist_method)
             cm = cm & elected
-        kw = {}
+        kw: dict = {}
+        if use_lazy:
+            # per-feature on-demand cost: penalty x rows in the leaf whose
+            # value for f has not been fetched yet (ref:
+            # cost_effective_gradient_boosting.hpp:139)
+            unused = jnp.sum(
+                jnp.where(lazy_used_cur, 0.0, lazy_mask[None, :]), axis=1)
+            kw["cegb_lazy_cost"] = meta.cegb_lazy * unused
         if sp.has_monotone:
-            kw = dict(monotone=meta.monotone, constraint_min=cmin,
+            kw.update(monotone=meta.monotone, constraint_min=cmin,
                       constraint_max=cmax,
                       mono_penalty=mono_penalty_of(depth))
         if sp.extra_trees:
@@ -428,7 +446,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                         jnp.asarray(0.0, f32), -inf, inf,
                         jnp.asarray(0, jnp.int32), rand_tag=0,
                         used=cegb_used, branch=branch0[0],
-                        member_mask=row_mask)
+                        member_mask=row_mask, lazy_mask=row_mask,
+                        lazy_used_cur=lazy_used)
 
     ni = max(L - 1, 1)
     W = cat_bitset_words(B)
@@ -497,7 +516,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    cegb_used=cegb_used,
                    leaf_branch=branch0,
                    done=jnp.asarray(False),
-                   leaf_lo=leaf_lo0, leaf_hi=leaf_hi0)
+                   leaf_lo=leaf_lo0, leaf_hi=leaf_hi0,
+                   lazy_used=(lazy_used if use_lazy
+                              else jnp.zeros((1, 1), bool)))
 
     def partition_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
                            isc, bitset):
@@ -740,6 +761,20 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             new_sum_h = (st.leaf_sum_h.at[best_leaf].set(lsum_h)
                          .at[new_leaf].set(rsum_h))
 
+            if use_lazy:
+                # mark the split leaf's (bagged-in) rows as fetched for the
+                # winning feature BEFORE the child scans (ref:
+                # cost_effective_gradient_boosting.hpp:125-135
+                # UpdateLeafBestSplits lazy branch)
+                lz_l = (leaf_id == best_leaf).astype(f32) * row_mask
+                lz_r = (leaf_id == new_leaf).astype(f32) * row_mask
+                in_parent = (lz_l + lz_r) > 0
+                new_lazy = st.lazy_used.at[feat].set(
+                    st.lazy_used[feat] | in_parent)
+            else:
+                lz_l = lz_r = None
+                new_lazy = st.lazy_used
+
             if use_intermediate:
                 # --- intermediate mode (ref: monotone_constraints.hpp:516
                 # IntermediateLeafConstraints).  TPU redesign: instead of
@@ -821,12 +856,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                  pd.left_output[best_leaf], l_min, l_max,
                                  depth, rand_tag=2 * tag_base + 1,
                                  used=used_vec, branch=child_branch,
-                                 member_mask=lmaskf if use_voting else None)
+                                 member_mask=lmaskf if use_voting else None,
+                                 lazy_mask=lz_l, lazy_used_cur=new_lazy)
                 best_r = best_of(hist_r, rsum_g, rsum_h, cnt_r,
                                  pd.right_output[best_leaf], r_min, r_max,
                                  depth, rand_tag=2 * tag_base + 2,
                                  used=used_vec, branch=child_branch,
-                                 member_mask=rmaskf if use_voting else None)
+                                 member_mask=rmaskf if use_voting else None,
+                                 lazy_mask=lz_r, lazy_used_cur=new_lazy)
                 pending = _pending_set(_pending_set(pd, best_leaf, best_l),
                                        new_leaf, best_r)
             return _State(tree=tree, pending=pending, leaf_id=leaf_id,
@@ -839,7 +876,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           cegb_used=used_vec,
                           leaf_branch=leaf_branch,
                           done=st.done,
-                          leaf_lo=leaf_lo, leaf_hi=leaf_hi)
+                          leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                          lazy_used=new_lazy)
 
         if forced_leaf is not None:
             # an invalid forced split (empty child) is skipped; growth
@@ -921,6 +959,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # forced splits return their slot to best-gain growth, and the
         # dynamic num_leaves < L guard in body enforces the budget
         state = jax.lax.fori_loop(0, L - 1, body, state)
+    if use_lazy:
+        # persistent per-(feature, row) fetched bitset rides along so the
+        # driver can thread it into the next tree
+        return state.tree, state.leaf_id, state.lazy_used
     return state.tree, state.leaf_id
 
 

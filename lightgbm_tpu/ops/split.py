@@ -83,6 +83,11 @@ class SplitParams(NamedTuple):
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # lazy per-(row, feature) acquisition penalty (ref:
+    # cost_effective_gradient_boosting.hpp:139 CalculateOndemandCosts):
+    # the scan receives the per-feature cost already summed over the
+    # leaf's not-yet-fetched rows
+    has_cegb_lazy: bool = False
     max_cat_threshold: int = 32
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
@@ -285,6 +290,7 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     constraint_min: jnp.ndarray = None,
                     constraint_max: jnp.ndarray = None,
                     mono_penalty: jnp.ndarray = None,
+                    cegb_lazy_cost: jnp.ndarray = None,
                     return_feature_gains: bool = False) -> SplitResult:
     """Scan all (feature, threshold, direction) candidates; return the leaf's best.
 
@@ -444,6 +450,10 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         if cegb_coupled is not None:
             delta = delta + params.cegb_tradeoff * jnp.where(
                 cegb_used, 0.0, cegb_coupled)
+        if params.has_cegb_lazy and cegb_lazy_cost is not None:
+            # ref: cost_effective_gradient_boosting.hpp:91 DeltaGain's
+            # CalculateOndemandCosts term
+            delta = delta + params.cegb_tradeoff * cegb_lazy_cost
         shifted = shifted - delta
     if params.has_monotone and params.monotone_penalty > 0:
         # depth-based penalty on monotone features' gains

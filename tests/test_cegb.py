@@ -64,3 +64,92 @@ def test_coupled_penalty_paid_once():
                   lgb.Dataset(X, label=y), num_boost_round=8)
     imp = b._gbdt.feature_importance("split")
     assert imp[0] >= 8, imp  # used across trees after first purchase
+
+
+def test_lazy_penalty_avoids_expensive_features():
+    """cegb_penalty_feature_lazy charges penalty x (rows in the leaf whose
+    value is not yet fetched) per candidate (ref:
+    cost_effective_gradient_boosting.hpp:139 CalculateOndemandCosts):
+    prohibitively lazy-expensive noise features never get used."""
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b = lgb.train({**base,
+                   "cegb_penalty_feature_lazy": [0.0, 0.0, 1e5, 1e5]},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = b._gbdt.feature_importance("split")
+    assert imp[2] == 0 and imp[3] == 0, imp
+    assert imp[0] > 0 and imp[1] > 0, imp
+
+
+def test_lazy_penalty_charged_per_row_not_per_feature():
+    """Lazy differs from coupled: the charge scales with the number of
+    not-yet-fetched rows in the leaf.  A penalty small enough to pay at a
+    leaf but too big at the root forces the first split elsewhere, and
+    once rows are fetched, re-splits on the same rows are free (the
+    bitset persists across trees)."""
+    rng = np.random.RandomState(7)
+    n = 2000
+    X = np.stack([rng.rand(n), rng.rand(n)], 1)
+    # feature 1 slightly better at the root, feature 0 nearly as good
+    y = (1.1 * (X[:, 1] > 0.5) + 1.0 * (X[:, 0] > 0.5)
+         + 0.05 * rng.randn(n))
+    base = {"objective": "regression", "num_leaves": 4, "verbosity": -1,
+            "min_data_in_leaf": 5, "learning_rate": 0.5}
+    # per-row penalty on feature 1 big enough to lose the root contest
+    # (root charge = p * 2000 exceeds its gain edge) but affordable at
+    # half-size child leaves
+    b = lgb.train({**base, "cegb_penalty_feature_lazy": [0.0, 0.1],
+                   "cegb_tradeoff": 1.0},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    b._gbdt._sync_model()
+    t0 = b._gbdt.models_[0]
+    assert t0.split_feature[0] == 0, "root should dodge the lazy charge"
+    # the first tree's child splits fetch feature 1's rows; the bitset
+    # persists across trees, so the SECOND tree's root uses it for free
+    imp = b._gbdt.feature_importance("split")
+    assert imp[1] > 0, imp
+    t1 = b._gbdt.models_[1]
+    assert t1.split_feature[0] == 1, "fetched rows should be free now"
+
+
+def test_lazy_penalty_composes_with_split_penalty():
+    X, y = _data(n=1500)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "cegb_penalty_split": 1e-4,
+                   "cegb_penalty_feature_lazy": [1e-4] * 4},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    p = b.predict(X)
+    assert np.isfinite(p).all()
+    auc_like = np.mean((p > 0.5) == (y > 0.5))
+    assert auc_like > 0.8
+
+
+def test_lazy_penalty_composes_with_basic_monotone():
+    """Regression: the monotone kwargs must not clobber the lazy cost in
+    the scan (kw overwrite bug) — expensive features stay unused even
+    with monotone constraints active."""
+    X, y = _data()
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "monotone_constraints": [1, 0, 0, 0],
+                   "cegb_penalty_feature_lazy": [0.0, 0.0, 1e5, 1e5]},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    imp = b._gbdt.feature_importance("split")
+    assert imp[2] == 0 and imp[3] == 0, imp
+
+
+def test_lazy_penalty_under_rf_boosting():
+    """Regression: RF's grow call must thread (and persist) the lazy
+    bitset instead of crashing on the 3-tuple return."""
+    X, y = _data(n=1500)
+    b = lgb.train({"objective": "binary", "boosting": "rf",
+                   "bagging_freq": 1, "bagging_fraction": 0.7,
+                   "num_leaves": 15, "verbosity": -1,
+                   "min_data_in_leaf": 5,
+                   "cegb_penalty_feature_lazy": [0.0, 0.0, 1e5, 1e5]},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    imp = b._gbdt.feature_importance("split")
+    assert imp[2] == 0 and imp[3] == 0, imp
+    assert np.isfinite(b.predict(X)).all()
