@@ -334,6 +334,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return jnp.clip((u * span).astype(jnp.int32), 0,
                         jnp.maximum(meta.num_bin - 3, 0)).astype(jnp.int32)
 
+    def _rand_cat_us(tag):
+        """[F, 2] uniforms for the categorical USE_RAND draws (one-hot
+        candidate bin + sorted-subset prefix; feature_histogram.cpp:187,268),
+        from a stream distinct from the numerical draws."""
+        return jax.random.uniform(
+            jax.random.fold_in(jax.random.fold_in(_extra_key, 0x5EED), tag),
+            (num_features, 2))
+
     use_voting = params.voting is not None
     if use_voting:
         assert params.compact_min == 0 and not params.use_hist_stack \
@@ -385,6 +393,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                       mono_penalty=mono_penalty_of(depth))
         if sp.extra_trees:
             kw["rand_bin"] = _rand_bins(rand_tag)
+            if sp.has_categorical:
+                kw["rand_cat_u"] = _rand_cat_us(rand_tag)
         if sp.has_cegb:
             kw["cegb_coupled"] = meta.cegb_coupled
             kw["cegb_used"] = used

@@ -125,6 +125,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                             jnp.maximum(meta.num_bin - 3, 0)[None, :]
                             ).astype(jnp.int32)
 
+        def _rand_cat_us(tag):
+            """[NLp_max, F, 2] uniforms for the categorical USE_RAND
+            draws (feature_histogram.cpp:187,268)."""
+            return jax.random.uniform(
+                jax.random.fold_in(jax.random.fold_in(_extra_key, 0x5EED),
+                                   tag), (Lp, num_features, 2))
+
     if sp.has_monotone:
         def _pen_of(depth):
             """ref: monotone_constraints.hpp:357."""
@@ -151,15 +158,17 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             kth = jax.lax.top_k(u, _bynode_k)[0][:, -1:]
             return u >= kth
 
-    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, used, bym):
+    def _best_one(h, sg, sh, c, po, cmin, cmax, dep, rb, rcu, used, bym):
         h = bundle_hist_to_features(h, sg, sh, meta, B, hist_B,
                                     params.has_bundles)
         kw = {}
         if sp.has_monotone:
-            kw = dict(monotone=meta.monotone, constraint_min=cmin,
+            kw.update(monotone=meta.monotone, constraint_min=cmin,
                       constraint_max=cmax, mono_penalty=_pen_of(dep))
         if sp.extra_trees:
             kw["rand_bin"] = rb
+            if sp.has_categorical:
+                kw["rand_cat_u"] = rcu
         if sp.has_cegb:
             kw["cegb_coupled"] = meta.cegb_coupled
             kw["cegb_used"] = used
@@ -175,6 +184,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if sp.has_monotone else None,
                                 0 if sp.has_monotone else None,
                                 0 if sp.extra_trees else None,
+                                0 if (sp.extra_trees
+                                      and sp.has_categorical) else None,
                                 None,
                                 0 if use_bynode else None))
 
@@ -226,14 +237,16 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         counts = jnp.round(fcounts).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
         rb = (_rand_bins(tree.num_leaves)[:NLp] if sp.extra_trees else None)
+        rcu = (_rand_cat_us(tree.num_leaves)[:NLp]
+               if sp.extra_trees and sp.has_categorical else None)
         mono_args = ((leaf_cmin[:NLp], leaf_cmax[:NLp],
                       tree.leaf_depth[:NLp]) if sp.has_monotone
                      else (None, None, None))
         bym = (_bynode_masks(tree.num_leaves)[:NLp] if use_bynode
                else None)
         best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                       counts, leaf_out[:NLp], *mono_args, rb, used_vec,
-                       bym)
+                       counts, leaf_out[:NLp], *mono_args, rb, rcu,
+                       used_vec, bym)
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget

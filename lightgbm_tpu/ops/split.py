@@ -154,12 +154,19 @@ def _round_int(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
-                    parent_output, min_gain_shift, p: SplitParams):
+                    parent_output, min_gain_shift, p: SplitParams,
+                    rand_u=None):
     """Per-feature best CATEGORICAL split (ref: feature_histogram.cpp:144
     FindBestThresholdCategoricalInner), vectorized over features.
 
     Bin 0 is the NaN/other bin and never enters a left set (the reference
     scans actual bins [1, num_bin); unseen/NaN categories route right).
+
+    Under extra_trees (USE_RAND), rand_u is [F, 2] uniforms: one draw
+    picks the single one-hot candidate bin (rand.NextInt(bin_start,
+    bin_end), cpp:187), the other the single sorted-subset prefix length
+    (rand.NextInt(0, max_threshold), cpp:268); the group-count reset
+    still runs for skipped candidates (cpp:310-317 order).
 
     Returns per-feature (gain [F], left_g, left_h, left_c, use_onehot,
     onehot_bin, dir_is_fwd, prefix_len, used_bin, sorted_bins [F, B]).
@@ -189,9 +196,16 @@ def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
     # ---- one-hot mode: left = single category (hpp use_onehot branch) ----
     # cat_l2 does NOT apply here: the reference adds it to l2 only in the
     # sorted-subset else-branch (feature_histogram.cpp:250)
+    oh_ok = in_range
+    if p.extra_trees and rand_u is not None:
+        # single random candidate bin in [1, num_bin)
+        span = jnp.maximum(num_bin - 1, 1).astype(f32)
+        oh_rand = 1 + jnp.clip((rand_u[:, 0] * span).astype(i32), 0,
+                               jnp.maximum(num_bin - 2, 0))
+        oh_ok = oh_ok & (bins == oh_rand[:, None])
     oh_gain = split_gain(grad, hess + K_EPSILON, cnt,
                          sum_g - grad, sum_h - hess - K_EPSILON,
-                         num_data - cnt, in_range, p)
+                         num_data - cnt, oh_ok, p)
     oh_best = jnp.argmax(oh_gain, axis=1).astype(i32)
     take1 = lambda a, idx: jnp.take_along_axis(a, idx[:, None], 1)[:, 0]
     oh_best_gain = take1(oh_gain, oh_best)
@@ -207,6 +221,14 @@ def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
     used_bin = jnp.sum(valid.astype(i32), axis=1)                # [F]
     max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin + 1) // 2)
     steps = min(p.max_cat_threshold, B)
+    if p.extra_trees and rand_u is not None:
+        # single random prefix length in [0, max_threshold) where
+        # max_threshold = max(min(max_num_cat, used_bin) - 1, 0)
+        max_thr = jnp.maximum(
+            jnp.minimum(max_num_cat, used_bin) - 1, 0).astype(f32)
+        sub_rand = jnp.clip((rand_u[:, 1] * jnp.maximum(max_thr, 1.0))
+                            .astype(i32), 0,
+                            jnp.maximum(max_thr.astype(i32) - 1, 0))
 
     def scan_dir(fwd: bool):
         if fwd:
@@ -241,8 +263,12 @@ def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
                         & (cum >= p.min_data_per_group))
             raw = (leaf_gain(lg, lh, lc.astype(f32), parent_output, pcat)
                    + leaf_gain(rg, rh, rc.astype(f32), parent_output, pcat))
-            gain = jnp.where(eligible & (raw > min_gain_shift), raw,
-                             K_MIN_SCORE)
+            gain_ok = eligible & (raw > min_gain_shift)
+            if p.extra_trees and rand_u is not None:
+                # USE_RAND: only the random prefix scores, but the group
+                # counter still resets on skipped candidates (cpp:310-317)
+                gain_ok = gain_ok & (i == sub_rand)
+            gain = jnp.where(gain_ok, raw, K_MIN_SCORE)
             cum = jnp.where(eligible, 0, cum)
             return (cum, lg, lh, lc), (gain, lg, lh, lc)
 
@@ -291,6 +317,7 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     constraint_max: jnp.ndarray = None,
                     mono_penalty: jnp.ndarray = None,
                     cegb_lazy_cost: jnp.ndarray = None,
+                    rand_cat_u: jnp.ndarray = None,
                     return_feature_gains: bool = False) -> SplitResult:
     """Scan all (feature, threshold, direction) candidates; return the leaf's best.
 
@@ -424,7 +451,8 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
          c_order) = _cat_best_split(
             hist[ci, :, 0], hist[ci, :, 1], cnt_factor,
             num_bin[ci], sum_g, sum_h, num_data, parent_output,
-            min_gain_shift, params)
+            min_gain_shift, params,
+            rand_u=None if rand_cat_u is None else rand_cat_u[ci])
         # categorical features replace their numerical scan results;
         # double-guard with is_cat_f (a numerical feature listed in
         # cat_features must keep its numerical result)

@@ -163,3 +163,33 @@ def test_categorical_onehot_mode():
     pred = booster.predict(X)
     # perfect separation achievable with one-hot splits
     assert float(np.mean((pred - y) ** 2)) < 0.05
+
+
+import pytest
+
+
+@pytest.mark.parametrize("strategy", ["leafwise", "wave"])
+def test_categorical_extra_trees_random_candidates(strategy):
+    """extra_trees x categorical (ref: feature_histogram.cpp:187,268
+    USE_RAND draws): each scan evaluates ONE random one-hot bin / subset
+    prefix, so the model differs from the exhaustive scan but still
+    learns the subset structure.  Parametrized over both engines (the
+    wave path has its own rand-draw plumbing)."""
+    X, y, good = _cat_problem(n=3000, k=10, noise=0.1)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "learning_rate": 0.3,
+            "categorical_feature": [0], "tpu_growth_strategy": strategy}
+    b_full = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=10)
+    b_rand = lgb.train({**base, "extra_trees": True, "extra_seed": 9},
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+    p_full, p_rand = b_full.predict(X), b_rand.predict(X)
+    assert not np.allclose(p_full, p_rand), \
+        "extra_trees must randomize the categorical scan"
+    # still learns: good-subset membership is predicted
+    target = np.isin(X[:, 0].astype(int), list(good))
+    auc_like = np.mean(p_rand[target] > np.median(p_rand))
+    assert auc_like > 0.7, auc_like
+    # different seeds -> different draws
+    b_rand2 = lgb.train({**base, "extra_trees": True, "extra_seed": 10},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    assert not np.allclose(b_rand2.predict(X), p_rand)
