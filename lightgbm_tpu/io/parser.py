@@ -66,7 +66,18 @@ def parse_file(path: str, has_header: bool = False,
         else:
             label_idx = int(label_column)
 
+    from ..native import parser_lib
+    have_native = parser_lib() is not None
+    # the joined byte copy is only built when the native path will use it
+    body = "\n".join(lines).encode() if have_native else b""
+
     if kind == "libsvm":
+        # native hot loop (ref: parser.cpp LibSVMParser); Python fallback
+        if have_native:
+            from ..native import parse_libsvm_native
+            parsed = parse_libsvm_native(body)
+            if parsed is not None:
+                return parsed[0], parsed[1], None
         labels = np.empty(len(lines), dtype=np.float64)
         rows: List[List[Tuple[int, float]]] = []
         max_idx = -1
@@ -88,10 +99,19 @@ def parse_file(path: str, has_header: bool = False,
             header_names = None  # libsvm ignores header names for features
         return feats, labels, None
 
-    # dense: vectorized via np.genfromtxt-style manual split (handles '' -> NaN)
-    mat = np.array(
-        [[(np.nan if tok == "" or tok.lower() in ("na", "nan", "null") else float(tok))
-          for tok in line.split(delim)] for line in lines], dtype=np.float64)
+    # dense: native tokenizer when available (ref: parser.cpp CSVParser),
+    # else the vectorized Python path (handles '' -> NaN identically)
+    n_cols = len(lines[0].split(delim))
+    mat = None
+    if have_native:
+        from ..native import parse_dense_native
+        mat = parse_dense_native(body, delim, len(lines), n_cols)
+    if mat is None:
+        mat = np.array(
+            [[(np.nan if tok == "" or tok.lower() in ("na", "nan", "null")
+               else float(tok))
+              for tok in line.split(delim)] for line in lines],
+            dtype=np.float64)
     labels = mat[:, label_idx].copy()
     feats = np.delete(mat, label_idx, axis=1)
     if header_names is not None:

@@ -53,6 +53,70 @@ def _compile(src_path: str, tag: str) -> Optional[str]:
     return None
 
 
+_parser_lib = None
+_parser_tried = False
+
+
+def parser_lib():
+    """The compiled text-parser library, or None when no compiler works
+    (ref: src/io/parser.cpp — the reference's parsers are C++ too)."""
+    global _parser_lib, _parser_tried
+    if _parser_tried:
+        return _parser_lib
+    _parser_tried = True
+    path = _compile(os.path.join(_SRC_DIR, "parser.c"), "parser")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    c_dbl_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.lgbt_parse_dense.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char, ctypes.c_long,
+        ctypes.c_long, c_dbl_p]
+    lib.lgbt_parse_dense.restype = ctypes.c_long
+    lib.lgbt_libsvm_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.POINTER(ctypes.c_long)]
+    lib.lgbt_libsvm_scan.restype = ctypes.c_long
+    lib.lgbt_parse_libsvm.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        c_dbl_p, c_dbl_p]
+    lib.lgbt_parse_libsvm.restype = ctypes.c_long
+    _parser_lib = lib
+    return lib
+
+
+def parse_dense_native(data: bytes, delim: str, n_rows: int,
+                       n_cols: int):
+    """Dense text -> [n_rows, n_cols] float64 (NaN missing), or None when
+    the native parser is unavailable; raises ValueError on ragged rows."""
+    lib = parser_lib()
+    if lib is None:
+        return None
+    out = np.empty((n_rows, n_cols), np.float64)
+    got = lib.lgbt_parse_dense(data, len(data), delim.encode()[:1],
+                               n_rows, n_cols, out)
+    if got < 0:
+        raise ValueError("bad token or inconsistent column count on data "
+                         f"line {-got}")
+    return out[:got]
+
+
+def parse_libsvm_native(data: bytes):
+    """LibSVM text -> (features [n, max_idx+1] float64, labels [n]), or
+    None when the native parser is unavailable."""
+    lib = parser_lib()
+    if lib is None:
+        return None
+    max_idx = ctypes.c_long(-1)
+    n = lib.lgbt_libsvm_scan(data, len(data), ctypes.byref(max_idx))
+    n_cols = max(int(max_idx.value) + 1, 1)
+    feats = np.zeros((n, n_cols), np.float64)
+    labels = np.empty(n, np.float64)
+    got = lib.lgbt_parse_libsvm(data, len(data), n, n_cols, labels, feats)
+    if got < 0:
+        raise ValueError(f"malformed libsvm pair on data line {-got}")
+    return feats[:got], labels[:got]
+
+
 def treeshap_lib():
     """The compiled TreeSHAP library, or None when no compiler works."""
     global _shap_lib, _shap_tried

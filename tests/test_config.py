@@ -64,3 +64,15 @@ def test_config_file_parsing(tmp_path):
     assert cfg.task == "train"
     assert cfg.objective == "binary"
     assert cfg.num_iterations == 12
+
+
+def test_parameters_doc_in_sync():
+    """docs/Parameters.md is generated from PARAMS (the reference keeps
+    Parameters.rst generated from config.h the same way); a stale doc is
+    a test failure, mirroring the reference's parameter-generator CI."""
+    import subprocess, sys, os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_params_doc.py"),
+         "--check"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr

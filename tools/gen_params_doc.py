@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Generate docs/Parameters.md from the single-definition PARAMS table.
+
+Mirrors the reference's parameter-generator pipeline (ref:
+.ci/parameter-generator.py, which renders docs/Parameters.rst and
+src/io/config_auto.cpp from config.h doc-comments): one source of truth
+(lightgbm_tpu/config.py PARAMS) renders the user-facing doc, so the doc
+can never drift from the accepted parameters.
+
+Usage: python tools/gen_params_doc.py [--check]
+  --check  exit 1 if docs/Parameters.md is stale (CI guard)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import PARAMS  # noqa: E402
+
+HEADER = """# Parameters
+
+Auto-generated from `lightgbm_tpu/config.py` (`PARAMS`) by
+`tools/gen_params_doc.py` — edit the table there, not this file.
+
+Semantics follow the reference (LightGBM `docs/Parameters.rst`): the
+first occurrence of a parameter or any of its aliases wins; aliases
+normalize to the canonical name; unknown parameters warn.
+
+| Parameter | Type | Default | Aliases |
+|---|---|---|---|
+"""
+
+
+def render() -> str:
+    rows = []
+    for name, typ, default, aliases in PARAMS:
+        d = repr(default) if default != "" else '""'
+        a = ", ".join(aliases) if aliases else "—"
+        rows.append(f"| `{name}` | {typ} | `{d}` | {a} |")
+    return HEADER + "\n".join(rows) + "\n"
+
+
+def main() -> int:
+    out_path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "Parameters.md")
+    out_path = os.path.normpath(out_path)
+    text = render()
+    if "--check" in sys.argv:
+        if not os.path.exists(out_path) or open(out_path).read() != text:
+            print("docs/Parameters.md is stale; run tools/gen_params_doc.py")
+            return 1
+        print("docs/Parameters.md is up to date")
+        return 0
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(text)
+    print(f"wrote {out_path} ({len(PARAMS)} parameters)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
