@@ -11,31 +11,35 @@ Public surface mirrors python-package/lightgbm/__init__.py.
 
 __version__ = "0.1.0"
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .engine import cv, train
+from .engine import CVBooster, cv, train
 from .plotting import (create_tree_digraph, plot_importance,
                        plot_metric, plot_split_value_histogram, plot_tree)
 from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
                       LGBMRegressor)
-from .utils.log import LightGBMError, register_callback
+from .utils.log import (LightGBMError, register_callback,
+                        register_logger)
 
 __all__ = [
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "Booster",
+    "CVBooster",
     "Config",
     "Dataset",
     "EarlyStopException",
     "LightGBMError",
+    "Sequence",
     "cv",
     "early_stopping",
     "log_evaluation",
     "record_evaluation",
     "register_callback",
+    "register_logger",
     "train",
     "__version__",
 ]

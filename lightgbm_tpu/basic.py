@@ -7,7 +7,8 @@ io.dataset.Dataset and boosting.GBDT directly with the same surface.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Union
+import abc
+from typing import Any, Dict, List, Optional, Sequence as _TSeq, Union
 
 import numpy as np
 
@@ -33,6 +34,40 @@ def _coerce_matrix(data) -> np.ndarray:
     if hasattr(data, "toarray"):         # scipy CSR/CSC/COO
         data = data.toarray()
     return np.asarray(data, dtype=np.float64)
+
+
+class Sequence(abc.ABC):
+    """Generic batched/random data access interface for Dataset
+    construction (ref: python-package basic.py Sequence): supports
+    `len(seq)`, integer/slice indexing, and an optional `batch_size`.
+    Dataset accepts a Sequence (or list of Sequences, concatenated
+    row-wise) and reads it in batches, so the full data never needs to
+    exist as one in-memory array on the caller's side."""
+
+    batch_size = 4096
+
+    @abc.abstractmethod
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+def _materialize_sequences(seqs) -> np.ndarray:
+    """Batched reads -> one float64 matrix (the TPU Dataset bins from a
+    dense matrix; batching bounds the caller's per-read memory)."""
+    parts = []
+    for seq in seqs:
+        n = len(seq)
+        bs = max(1, int(getattr(seq, "batch_size", 4096) or 4096))
+        for lo in range(0, n, bs):
+            chunk = np.asarray(seq[lo:min(lo + bs, n)], dtype=np.float64)
+            parts.append(chunk.reshape(chunk.shape[0], -1))
+    if not parts:
+        log.fatal("Cannot construct a Dataset from empty Sequence input")
+    return np.concatenate(parts, axis=0)
 
 
 class Dataset:
@@ -70,6 +105,11 @@ class Dataset:
                 self._core.metadata.set_label(self.label)
         else:
             data = self.data
+            if isinstance(data, Sequence):
+                data = _materialize_sequences([data])
+            elif (isinstance(data, list) and data
+                    and all(isinstance(s, Sequence) for s in data)):
+                data = _materialize_sequences(data)
             # column names from pandas / arrow before coercion
             if self.feature_name == "auto":
                 if (type(data).__module__ or "").startswith("pyarrow") \
@@ -124,7 +164,7 @@ class Dataset:
                        group=group, init_score=init_score,
                        params=params or self.params)
 
-    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+    def subset(self, used_indices: _TSeq[int], params=None) -> "Dataset":
         core = self._core_or_construct().copy_subrow(
             np.asarray(used_indices, dtype=np.int64))
         out = Dataset.__new__(Dataset)

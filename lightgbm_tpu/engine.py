@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import copy
+import json
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -109,6 +110,52 @@ def train(params: Dict[str, Any], train_set: Dataset,
     return booster
 
 
+class CVBooster:
+    """Holds the per-fold boosters of cv() and redirects method calls to
+    each, returning per-fold result lists (ref: python-package engine.py
+    CVBooster).  Serializes as JSON of model texts + best_iteration."""
+
+    def __init__(self, model_file=None):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load(json.loads(f.read()))
+
+    def _load(self, payload: Dict[str, Any]) -> None:
+        self.best_iteration = payload["best_iteration"]
+        self.boosters = [Booster(model_str=s) for s in payload["boosters"]]
+
+    def model_from_string(self, model_str: str) -> "CVBooster":
+        self._load(json.loads(model_str))
+        return self
+
+    def model_to_string(self, num_iteration=None, start_iteration=0,
+                        importance_type="split") -> str:
+        return json.dumps({
+            "boosters": [b.model_to_string(num_iteration=num_iteration,
+                                           start_iteration=start_iteration,
+                                           importance_type=importance_type)
+                         for b in self.boosters],
+            "best_iteration": self.best_iteration})
+
+    def save_model(self, filename, num_iteration=None, start_iteration=0,
+                   importance_type="split") -> "CVBooster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name in ("boosters", "best_iteration"):
+            raise AttributeError(name)
+
+        def per_fold(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return per_fold
+
+
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
        metrics=None, feval=None, init_model=None,
@@ -164,5 +211,9 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         out[f"valid {metric}-mean"] = mean
         out[f"valid {metric}-stdv"] = std
     if return_cvbooster:
-        out["cvbooster"] = boosters
+        cvb = CVBooster()
+        cvb.boosters = boosters
+        cvb.best_iteration = max((b.best_iteration for b in boosters),
+                                 default=-1)
+        out["cvbooster"] = cvb
     return out

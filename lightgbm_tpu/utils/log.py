@@ -32,8 +32,26 @@ def register_callback(callback: Optional[Callable[[str], None]]) -> None:
     _LogState.callback = callback
 
 
-def _emit(msg: str) -> None:
-    if _LogState.callback is not None:
+def register_logger(logger, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Route info/warning output through a custom logger object
+    (ref: python-package basic.py register_logger)."""
+    for m in (info_method_name, warning_method_name):
+        if not callable(getattr(logger, m, None)):
+            raise TypeError(f"Logger must provide '{info_method_name}' and "
+                            f"'{warning_method_name}' method")
+    _LogState.logger = logger
+    _LogState.logger_info = info_method_name
+    _LogState.logger_warning = warning_method_name
+
+
+def _emit(msg: str, warning: bool = False) -> None:
+    logger = getattr(_LogState, "logger", None)
+    if logger is not None:
+        method = getattr(_LogState, "logger_warning" if warning
+                         else "logger_info")
+        getattr(logger, method)(msg)
+    elif _LogState.callback is not None:
         _LogState.callback(msg + "\n")
     else:
         print(msg, file=sys.stderr, flush=True)
@@ -51,7 +69,8 @@ def info(msg: str, *args) -> None:
 
 def warning(msg: str, *args) -> None:
     if _LogState.level >= 0:
-        _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
+        _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg),
+              warning=True)
 
 
 def fatal(msg: str, *args) -> None:

@@ -1,0 +1,111 @@
+"""Public API surface parity with the reference python package
+(ref: python-package/lightgbm/__init__.py __all__): CVBooster, Sequence,
+register_logger."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.75).astype(np.float32)
+    return X, y
+
+
+def test_reference_exports_present():
+    """Everything the reference exports (minus the Dask estimators —
+    dask is not in this runtime) exists here."""
+    for name in ["Dataset", "Booster", "CVBooster", "Sequence",
+                 "register_logger", "train", "cv", "LGBMModel",
+                 "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+                 "log_evaluation", "record_evaluation", "reset_parameter",
+                 "early_stopping", "EarlyStopException", "plot_importance",
+                 "plot_split_value_histogram", "plot_metric", "plot_tree",
+                 "create_tree_digraph"]:
+        assert hasattr(lgb, name), name
+
+
+def test_cvbooster_delegation_and_roundtrip(tmp_path):
+    X, y = _data()
+    res = lgb.cv({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                  "min_data_in_leaf": 5},
+                 lgb.Dataset(X, label=y), num_boost_round=4, nfold=3,
+                 return_cvbooster=True)
+    cvb = res["cvbooster"]
+    assert isinstance(cvb, lgb.CVBooster)
+    assert len(cvb.boosters) == 3
+    # method redirection returns one result per fold
+    preds = cvb.predict(X)
+    assert len(preds) == 3 and all(p.shape == (len(X),) for p in preds)
+    # JSON round trip
+    f = tmp_path / "cvb.json"
+    cvb.save_model(str(f))
+    cvb2 = lgb.CVBooster(model_file=str(f))
+    assert len(cvb2.boosters) == 3
+    for p1, p2 in zip(preds, cvb2.predict(X)):
+        np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    # pickling
+    import pickle
+    cvb3 = pickle.loads(pickle.dumps(cvb))
+    for p1, p3 in zip(preds, cvb3.predict(X)):
+        np.testing.assert_allclose(p1, p3, rtol=1e-6)
+
+
+def test_sequence_dataset_construction():
+    X, y = _data(n=500)
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 7,
+              "min_data_in_leaf": 5}
+    b_seq = lgb.train(params, lgb.Dataset(ArrSeq(X), label=y),
+                      num_boost_round=3)
+    b_arr = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(b_seq.predict(X), b_arr.predict(X),
+                               rtol=1e-6)
+    # list of sequences concatenates row-wise
+    half = len(X) // 2
+    b_two = lgb.train(params, lgb.Dataset([ArrSeq(X[:half]),
+                                           ArrSeq(X[half:])], label=y),
+                      num_boost_round=3)
+    np.testing.assert_allclose(b_two.predict(X), b_arr.predict(X),
+                               rtol=1e-6)
+
+
+def test_register_logger_routes_messages():
+    records = []
+
+    class MyLogger:
+        def info(self, msg):
+            records.append(("info", msg))
+
+        def warning(self, msg):
+            records.append(("warning", msg))
+
+    from lightgbm_tpu.utils import log as _log
+    lgb.register_logger(MyLogger())
+    old_level = _log.get_verbosity()
+    _log.set_verbosity(1)
+    try:
+        _log.info("hello %d", 7)
+        _log.warning("watch out")
+        assert ("info", "[LightGBM-TPU] [Info] hello 7") in records
+        assert ("warning", "[LightGBM-TPU] [Warning] watch out") in records
+        with pytest.raises(TypeError):
+            lgb.register_logger(object())
+    finally:
+        _log._LogState.logger = None
+        _log.set_verbosity(old_level)
