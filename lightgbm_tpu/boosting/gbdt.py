@@ -362,6 +362,17 @@ class GBDT:
             feature_fraction_bynode=config.feature_fraction_bynode,
             bynode_seed=config.feature_fraction_seed + 1,
             monotone_intermediate=self._mono_intermediate,
+            # int8 MXU histogram path for quantized training (grid must
+            # fit int8; hessian ints reach num_grad_quant_bins).  The
+            # int32 accumulator must hold n * max_int for a root-level
+            # cell (the reference bounds this with per-leaf 8/16/32-bit
+            # histogram widths, SetNumBitsInHistogramBin); larger inputs
+            # fall back to the fp32 kernel
+            quant_bins=(config.num_grad_quant_bins
+                        if (config.use_quantized_grad
+                            and config.num_grad_quant_bins <= 126
+                            and self.n_pad * config.num_grad_quant_bins
+                            < 2**31) else 0),
             use_hist_stack=stack_bytes <= budget,
             # Fused Pallas one-hot kernel on TPU (one-hot tiles live only in
             # VMEM, like the CUDA shared-memory histogram kernels); XLA's
@@ -632,7 +643,8 @@ class GBDT:
                 gi = jnp.trunc(grad / gscale + jnp.sign(grad) * rg)
                 hi = (jnp.ones_like(hess) if const_hess
                       else jnp.trunc(hess / hscale + rh))
-                return gi * gscale, hi * hscale
+                return (gi * gscale, hi * hscale,
+                        jnp.stack([gscale, hscale]))
             self._discretize_fn = jax.jit(_disc)
             if config.quant_train_renew_leaf:
                 renew_p = SplitParams(
@@ -847,10 +859,10 @@ class GBDT:
                 if self.use_quant:
                     # per-tree discretization (ref: serial_tree_learner
                     # BeforeTrain -> DiscretizeGradients on the class slice)
-                    gq, hq = self._discretize_fn(
+                    gq, hq, qscales = self._discretize_fn(
                         g_k, h_k, np.int32(self.iter_ * K + k))
                 else:
-                    gq, hq = g_k, h_k
+                    gq, hq, qscales = g_k, h_k, None
                 with global_timer.scope("GBDT::grow_tree"):
                     grow_kw = ({"cegb_used": self._cegb_used}
                                if self._cegb_used is not None else {})
@@ -863,6 +875,10 @@ class GBDT:
                             + k)
                     if self._lazy_used is not None:
                         grow_kw["lazy_used"] = self._lazy_used
+                    if (qscales is not None
+                            and self.growth_strategy == "wave"
+                            and self.grow_params.quant_bins > 0):
+                        grow_kw["quant_scales"] = qscales
                     out = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,

@@ -101,6 +101,10 @@ class GrowParams(NamedTuple):
     # and reduce only the elected histograms across the mesh.  Requires
     # the masked engine (compact_min=0), no hist stack, no bundles.
     voting: object = None
+    # quantized training: num_grad_quant_bins when use_quantized_grad —
+    # the wave engine's Pallas kernel then accumulates exact int32
+    # histograms through the MXU int8 path (needs quant_scales at call)
+    quant_bins: int = 0
     # monotone_constraints_method=intermediate (ref:
     # monotone_constraints.hpp:516 IntermediateLeafConstraints): leaf
     # hyper-rectangles in bin space + a pairwise constraint recompute and

@@ -75,7 +75,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    row_mask: jnp.ndarray, col_mask: jnp.ndarray,
                    meta: FeatureMeta, params: GrowParams,
                    cegb_used: jnp.ndarray = None,
-                   extra_tag: jnp.ndarray = None):
+                   extra_tag: jnp.ndarray = None,
+                   quant_scales: jnp.ndarray = None):
     """Grow one tree by waves.  Same contract as grow.grow_tree."""
     from ..ops.split import MISSING_NAN, MISSING_ZERO
 
@@ -101,9 +102,20 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     use_pallas = params.hist_method == "pallas"
 
+    use_int8 = (use_pallas and params.quant_bins > 0
+                and quant_scales is not None)
+
     def hists_of(leaf_id, num_slots):
         """Group-space histograms; converted per slot at the scan."""
         if use_pallas:
+            if use_int8:
+                # quantized grid grads -> exact int32 accumulation through
+                # the MXU int8 path (ref: dense_bin.hpp:174
+                # ConstructHistogramIntInner)
+                return build_histogram_wave(
+                    binned, leaf_id, gh, max_bin=hist_B,
+                    num_slots=num_slots, quant_bins=params.quant_bins,
+                    quant_scales=quant_scales)
             return build_histogram_wave(binned, leaf_id, gh,
                                         max_bin=hist_B, num_slots=num_slots)
         return _hist_wave_xla(binned, leaf_id, gh, max_bin=hist_B,

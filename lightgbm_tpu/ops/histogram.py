@@ -169,6 +169,13 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
         @pl.when((bg == 0) & (g == 0) & (pl.program_id(2) == 0))
         def _init_cnt():
             cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        # quantized mode: int8 operands (half the one-hot bytes, 2x MXU
+        # int8 rate) with exact int32 accumulation — Mosaic legalizes int8
+        # select and int8 dot, but NOT int8 multiply, so the channel
+        # matrix is built with where() instead of mask*value
+        int8_mode = out_ref.dtype == jnp.int32
+        mxu_t = jnp.int8 if int8_mode else jnp.bfloat16
+        acc_t = jnp.int32 if int8_mode else jnp.float32
         # offset the SMALL [Fg, Rt] rows instead of the big [Fg, Bg, Rt]
         # iota: the one-hot construction is the per-wave VPU floor, so
         # every elementwise pass over the big shape counts
@@ -177,7 +184,7 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
         gh = gh_ref[...]                                 # [Rt, C+1]
         Rt = rows.shape[1]
         biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
-        oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
+        oh = (rows[:, None, :] == biota).astype(mxu_t)
         oh2 = oh.reshape(Fg * Bg, Rt)
         S = out_ref.shape[-1] // (C * NLg)
         for s in range(S):  # slot groups REUSE the bin one-hot (its VPU
@@ -186,13 +193,22 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
             soh = (loc == jax.lax.broadcasted_iota(jnp.int32, (Rt, NLg), 1))
             # [Rt, C*NLg] (c-major): channel value where the slot matches
             # (built 2-D per channel — Mosaic cannot insert a bf16 minor dim)
-            sohb = soh.astype(jnp.bfloat16)
-            sc = jnp.concatenate(
-                [sohb * gh[:, c:c + 1].astype(jnp.bfloat16)
-                 for c in range(C)], axis=1)
+            if int8_mode:
+                # select in int32 (Mosaic relayouts i1->i8 selects badly),
+                # then narrow to int8 for the MXU operand
+                sc = jnp.concatenate(
+                    [jnp.where(soh,
+                               jnp.broadcast_to(gh[:, c:c + 1], (Rt, NLg)),
+                               0).astype(jnp.int8)
+                     for c in range(C)], axis=1)
+            else:
+                sohb = soh.astype(jnp.bfloat16)
+                sc = jnp.concatenate(
+                    [sohb * gh[:, c:c + 1].astype(jnp.bfloat16)
+                     for c in range(C)], axis=1)
             acc = jax.lax.dot_general(
                 oh2, sc, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [Fg*Bg, C*NLg]
+                preferred_element_type=acc_t)            # [Fg*Bg, C*NLg]
             # lane dim stays flat (Mosaic cannot split the lane dim); the
             # caller unscrambles the (slot-group, channel, slot) layout
             w = C * NLg
@@ -202,11 +218,18 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
             # only, replacing a separate 20ms scatter-add pass
             @pl.when((bg == 0) & (g == 0))
             def _count():
-                mask8 = jnp.broadcast_to(
-                    gh[:, C:C + 1].astype(jnp.bfloat16), (Rt, 8)).T
+                if int8_mode:
+                    mask8 = jnp.broadcast_to(gh[:, C:C + 1],
+                                             (Rt, 8)).T.astype(jnp.int8)
+                    sohm = jnp.where(
+                        soh, 1, 0).astype(jnp.int8)
+                else:
+                    mask8 = jnp.broadcast_to(
+                        gh[:, C:C + 1].astype(mxu_t), (Rt, 8)).T
+                    sohm = soh.astype(mxu_t)
                 cacc = jax.lax.dot_general(
-                    mask8, sohb, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)  # [8, NLg]
+                    mask8, sohm, (((1,), (0,)), ((), ())),
+                    preferred_element_type=acc_t)        # [8, NLg]
                 cnt_ref[:, s * NLg:(s + 1) * NLg] += cacc
     return kernel
 
@@ -239,10 +262,12 @@ def wave_pallas_vmem_ok(num_features: int, max_bin: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_bin", "num_slots", "row_tile"))
+                   static_argnames=("max_bin", "num_slots", "row_tile",
+                                    "quant_bins"))
 def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
                          gh: jnp.ndarray, *, max_bin: int, num_slots: int,
-                         row_tile: int = 512):
+                         row_tile: int = 512, quant_bins: int = 0,
+                         quant_scales: jnp.ndarray = None):
     """Histograms for all leaf slots in one fused pass over the rows.
 
     Grid = (bin groups, feature groups, row tiles); each cell builds the
@@ -262,11 +287,26 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
       gh: [n, C+1] per-row accumulands (gradient, hessian, ..., row-mask);
         the LAST column is the count mask (zeros for excluded rows).
       max_bin: B (static).  num_slots: NL leaf slots (static).
+      quant_bins: when > 0, gh's channels carry grid-snapped quantized
+        values (ref: gradient_discretizer.cpp DiscretizeGradients): the
+        kernel recovers the int8 grid indices and accumulates EXACT int32
+        histograms through the MXU's 2x int8 path, dequantizing on the
+        way out — the TPU analogue of the reference's int16/int32
+        quantized histograms (dense_bin.hpp:174 ConstructHistogramIntInner).
 
     Returns: (hist [NL, F, B, C] float32, counts [NL] float32).
     """
     F, n = binned_fm.shape
     C = gh.shape[-1] - 1
+    use_int8 = quant_scales is not None
+    if use_int8:
+        assert quant_bins <= 126, "int8 grid bound"
+        # gh's channels carry k * scale for int grid indices k; divide by
+        # the TRUE scales (threaded from DiscretizeGradients) so the
+        # round() recovers the exact ints
+        gh = jnp.concatenate(
+            [jnp.round(gh[:, :C] / quant_scales[None, :]).astype(jnp.int32),
+             (gh[:, C:] > 0).astype(jnp.int32)], axis=1)
     NLp = wave_slot_pad(num_slots)
     NLg = min(NLp, 128)
     Bp = max(8, (max_bin + 7) // 8 * 8)
@@ -286,6 +326,7 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     # the [Fg, Bg, Rt] bf16 one-hot
     Fg = _pick_feature_group(
         Fp, Bg * (S * C * NLg * 4 + row_tile * 2), 6 << 20)
+    acc_t = jnp.int32 if use_int8 else jnp.float32
     out, cnt = pl.pallas_call(
         _wave_kernel(C, Fg, Bg, NLg),
         grid=(Bp // Bg, Fp // Fg, n // row_tile),
@@ -298,12 +339,16 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
                          lambda bg, g, i: (g, bg, 0)),
             pl.BlockSpec((8, NLp), lambda bg, g, i: (0, 0))],
         out_shape=[
-            jax.ShapeDtypeStruct((Fp, Bp, S * C * NLg), jnp.float32),
-            jax.ShapeDtypeStruct((8, NLp), jnp.float32)],
+            jax.ShapeDtypeStruct((Fp, Bp, S * C * NLg), acc_t),
+            jax.ShapeDtypeStruct((8, NLp), acc_t)],
     )(binned_fm, slot.reshape(n, 1), gh)
     # [Fp, Bp, (s, c, lg)] -> [NL, F, B, C]
     out = out.reshape(Fp, Bp, S, C, NLg).transpose(2, 4, 0, 1, 3)
     hist = out.reshape(S * NLg, Fp, Bp, C)[:num_slots, :F, :max_bin, :]
+    if use_int8:
+        # dequantize the exact int sums back to the float grid
+        hist = hist.astype(jnp.float32) * quant_scales[None, None, None, :]
+        return hist, cnt[0, :num_slots].astype(jnp.float32)
     return hist, cnt[0, :num_slots]
 
 

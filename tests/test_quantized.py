@@ -68,7 +68,7 @@ def test_quantized_gradients_live_on_grid():
                         lgb.Dataset(X, label=y), num_boost_round=1)
     g = booster._gbdt
     grad, hess = g._grad_fn(g.scores)
-    gq, hq = g._discretize_fn(g._slice_row_fn(grad, 0),
+    gq, hq, _ = g._discretize_fn(g._slice_row_fn(grad, 0),
                               g._slice_row_fn(hess, 0), np.int32(0))
     gq = np.asarray(gq)
     grad0 = np.asarray(grad)[0]
@@ -107,6 +107,6 @@ def test_quantized_constant_hessian_is_exact_ones():
                         lgb.Dataset(X, label=y), num_boost_round=1)
     g = booster._gbdt
     grad, hess = g._grad_fn(g.scores)
-    _, hq = g._discretize_fn(g._slice_row_fn(grad, 0),
+    _, hq, _ = g._discretize_fn(g._slice_row_fn(grad, 0),
                              g._slice_row_fn(hess, 0), np.int32(0))
     np.testing.assert_allclose(np.asarray(hq), 1.0, rtol=1e-6)
