@@ -151,6 +151,10 @@ class Dataset:
                     max_bin_by_feature=cfg.max_bin_by_feature or None)
         if self.position is not None:
             self._core.metadata.set_position(self.position)
+        if self.free_raw_data and not isinstance(self.data, (str, bytes)):
+            # the core keeps its own raw copy only when needed
+            # (linear trees / free_raw_data=False), matching the reference
+            self.data = None
         return self
 
     def _core_or_construct(self) -> _CoreDataset:
@@ -214,6 +218,154 @@ class Dataset:
         if self._core is not None and self._core.metadata.query_boundaries is not None:
             return np.diff(self._core.metadata.query_boundaries)
         return self.group
+
+    def get_init_score(self):
+        return (self._core.metadata.init_score
+                if self._core is not None else self.init_score)
+
+    def get_position(self):
+        return (self._core.metadata.position
+                if self._core is not None else self.position)
+
+    def set_position(self, position) -> "Dataset":
+        self.position = position
+        if self._core is not None and position is not None:
+            self._core.metadata.set_position(position)
+        return self
+
+    def get_data(self):
+        """Raw data (ref: basic.py get_data; raises after the raw data was
+        freed, matching the reference's error).  Subsets return their own
+        rows."""
+        if self._core is not None and self.data is None:
+            log.fatal("Cannot call `get_data` after freed raw data, set "
+                      "free_raw_data=False when construct Dataset to avoid "
+                      "this.")
+        if self.used_indices is not None and self.data is not None \
+                and not isinstance(self.data, (str, bytes)):
+            return _coerce_matrix(self.data)[np.asarray(self.used_indices)]
+        return self.data
+
+    def get_field(self, field_name: str):
+        """ref: basic.py get_field / LGBM_DatasetGetField."""
+        getter = {"label": self.get_label, "weight": self.get_weight,
+                  "group": self.get_group, "init_score": self.get_init_score,
+                  "position": self.get_position}.get(field_name)
+        if getter is None:
+            log.fatal(f"Unknown field name: {field_name}")
+        return getter()
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """ref: basic.py set_field / LGBM_DatasetSetField."""
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group,
+                  "init_score": self.set_init_score,
+                  "position": self.set_position}.get(field_name)
+        if setter is None:
+            log.fatal(f"Unknown field name: {field_name}")
+        return setter(data)
+
+    def get_feature_name(self) -> List[str]:
+        return self.feature_names()
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name != "auto":
+            names = list(feature_name)
+            if (self._core is not None
+                    and len(names) != self._core.num_total_features):
+                log.fatal("Length of feature_name error")
+            self.feature_name = names
+            if self._core is not None:
+                self._core.feature_names = list(map(str, names))
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """ref: basic.py set_categorical_feature: free while the raw data
+        is retained (triggers a re-bin); fatal once it was freed."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._core is not None:
+            if self.data is None:
+                log.fatal("Cannot set categorical feature after freed raw "
+                          "data, set free_raw_data=False when construct "
+                          "Dataset to avoid this.")
+            log.warning("categorical_feature in Dataset is overridden.\n"
+                        f"New categorical_feature is {categorical_feature}")
+            self._core = None
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """ref: basic.py set_reference: free while the raw data is
+        retained (triggers re-binning against the new reference)."""
+        if reference is self.reference:
+            return self
+        if self._core is not None:
+            if self.data is None:
+                log.fatal("Cannot set reference after freed raw data, set "
+                          "free_raw_data=False when construct Dataset to "
+                          "avoid this.")
+            self._core = None
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of Datasets reachable through reference links
+        (ref: basic.py get_ref_chain)."""
+        head = self
+        ref_chain = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """Number of bins for a feature (ref: basic.py feature_num_bin /
+        LGBM_DatasetGetFeatureNumBin)."""
+        core = self._core_or_construct()
+        if isinstance(feature, str):
+            feature = core.feature_names.index(feature)
+        return int(core.bin_mappers[feature].num_bin)
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another Dataset's features into this one
+        (ref: basic.py add_features_from / LGBM_DatasetAddFeaturesFrom).
+        Both must still hold raw data; the merged Dataset re-bins."""
+        for ds, tag in ((self, "self"), (other, "other")):
+            if ds.data is None:
+                log.fatal(f"Cannot add features from {tag} with freed raw "
+                          "data (set free_raw_data=False)")
+        a = _coerce_matrix(self.data)
+        b = _coerce_matrix(other.data)
+        if a.shape[0] != b.shape[0]:
+            log.fatal("Cannot add features from a Dataset with a different "
+                      "row count")
+        self.data = np.hstack([a, b])
+        if self.feature_name != "auto" and other.feature_name != "auto":
+            self.feature_name = (list(self.feature_name)
+                                 + list(other.feature_name))
+        else:
+            self.feature_name = "auto"
+
+        def _cats(ds, offset):
+            cf = ds.categorical_feature
+            if cf in ("auto", None):
+                return []
+            return [c if isinstance(c, str) else int(c) + offset
+                    for c in cf]
+        if not (self.categorical_feature in ("auto", None)
+                and other.categorical_feature in ("auto", None)):
+            self.categorical_feature = (_cats(self, 0)
+                                        + _cats(other, a.shape[1]))
+        self.reference = None  # widened columns cannot share old mappers
+        self._core = None      # re-bin on next construct
+        return self
 
     def num_data(self) -> int:
         return self._core_or_construct().num_data
@@ -287,6 +439,59 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """ref: basic.py get_leaf_output / LGBM_BoosterGetLeafValue."""
+        self._gbdt._sync_model()
+        return float(self._gbdt.models_[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """ref: basic.py set_leaf_output / LGBM_BoosterSetLeafValue."""
+        self._gbdt._sync_model()
+        self._gbdt.models_[tree_id].leaf_value[leaf_id] = float(value)
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of a feature's split threshold values across the model
+        (ref: basic.py get_split_value_histogram)."""
+        self._gbdt._sync_model()
+        if isinstance(feature, str):
+            feature = self.feature_name().index(feature)
+        values = []
+        for tree in self._gbdt.models_:
+            nl = tree.num_leaves
+            for i in range(max(nl - 1, 0)):
+                if (tree.split_feature[i] == feature
+                        and tree.decision_type[i] & 1 == 0):  # numerical
+                    values.append(float(tree.threshold[i]))
+        values = np.asarray(values, np.float64)
+        if bins is None or (isinstance(bins, int)
+                            and bins > max(len(values), 1)):
+            bins = max(len(values), 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            return ret[ret[:, 1] > 0]
+        return hist, bin_edges
+
+    def free_network(self) -> "Booster":
+        """No-op on TPU: collectives ride the XLA mesh runtime, there is
+        no socket network to tear down (ref: basic.py free_network;
+        SURVEY §2.2 N15)."""
+        self.network = False
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Accepted for API compatibility: multi-host runs configure the
+        mesh through jax.distributed instead (ref: basic.py set_network)."""
+        log.warning("set_network is a no-op on TPU: configure multi-host "
+                    "training via jax.distributed + tree_learner=data")
+        self.network = True
         return self
 
     def current_iteration(self) -> int:

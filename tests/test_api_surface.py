@@ -109,3 +109,65 @@ def test_register_logger_routes_messages():
     finally:
         _log._LogState.logger = None
         _log.set_verbosity(old_level)
+
+
+def test_dataset_field_accessors():
+    X, y = _data(n=300)
+    w = np.ones(len(y), np.float32)
+    ds = lgb.Dataset(X, label=y, weight=w, free_raw_data=False)
+    ds.set_field("init_score", np.zeros(len(y)))
+    ds.construct()
+    np.testing.assert_allclose(ds.get_field("label"), y)
+    np.testing.assert_allclose(ds.get_field("weight"), w)
+    assert ds.get_field("init_score") is not None
+    assert ds.get_data() is X
+    assert ds.get_feature_name() == ds.feature_names()
+    assert ds.feature_num_bin(0) > 1
+    ref = lgb.Dataset(X, label=y)
+    chain = ds.create_valid(X, label=y).get_ref_chain()
+    assert ds in chain
+
+
+def test_dataset_add_features_from():
+    X, y = _data(n=400)
+    d1 = lgb.Dataset(X[:, :2], label=y, free_raw_data=False)
+    d2 = lgb.Dataset(X[:, 2:], label=y, free_raw_data=False)
+    d1.add_features_from(d2)
+    assert d1.num_feature() == 4
+    b = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                   "min_data_in_leaf": 5}, d1, num_boost_round=3)
+    b_full = lgb.train({"objective": "binary", "verbosity": -1,
+                        "num_leaves": 7, "min_data_in_leaf": 5},
+                       lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(b.predict(X), b_full.predict(X), rtol=1e-6)
+
+
+def test_booster_leaf_output_and_split_histogram():
+    X, y = _data(n=500)
+    b = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                   "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                  num_boost_round=3)
+    v = b.get_leaf_output(0, 0)
+    assert np.isfinite(v)
+    b.set_leaf_output(0, 0, v + 1.0)
+    assert b.get_leaf_output(0, 0) == v + 1.0
+    b.set_leaf_output(0, 0, v)
+    hist, edges = b.get_split_value_histogram(0)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    xgb = b.get_split_value_histogram(0, bins=5, xgboost_style=True)
+    assert xgb.ndim == 2 and (xgb[:, 1] > 0).all()
+    # network shims
+    b.set_network(["host:1"], num_machines=2)
+    b.free_network()
+
+
+def test_get_data_subset_and_freed():
+    X, y = _data(n=200)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    sub = ds.subset([3, 5, 7])
+    np.testing.assert_allclose(sub.get_data(), X[[3, 5, 7]])
+    # default free_raw_data=True frees after construct -> get_data raises
+    ds2 = lgb.Dataset(X, label=y)
+    ds2.construct()
+    with pytest.raises(lgb.LightGBMError, match="free_raw_data=False"):
+        ds2.get_data()
