@@ -284,6 +284,9 @@ class Dataset:
         is retained (triggers a re-bin); fatal once it was freed."""
         if self.categorical_feature == categorical_feature:
             return self
+        if self.used_indices is not None:
+            log.fatal("Cannot modify a Dataset returned by subset(); "
+                      "apply the change to the parent Dataset instead")
         if self._core is not None:
             if self.data is None:
                 log.fatal("Cannot set categorical feature after freed raw "
@@ -300,6 +303,9 @@ class Dataset:
         retained (triggers re-binning against the new reference)."""
         if reference is self.reference:
             return self
+        if self.used_indices is not None:
+            log.fatal("Cannot modify a Dataset returned by subset(); "
+                      "apply the change to the parent Dataset instead")
         if self._core is not None:
             if self.data is None:
                 log.fatal("Cannot set reference after freed raw data, set "
@@ -317,7 +323,8 @@ class Dataset:
         while len(ref_chain) < ref_limit:
             if isinstance(head, Dataset):
                 ref_chain.add(head)
-                if head.reference is not None:
+                if (head.reference is not None
+                        and head.reference not in ref_chain):
                     head = head.reference
                 else:
                     break
@@ -337,6 +344,9 @@ class Dataset:
         """Column-concatenate another Dataset's features into this one
         (ref: basic.py add_features_from / LGBM_DatasetAddFeaturesFrom).
         Both must still hold raw data; the merged Dataset re-bins."""
+        if self.used_indices is not None or other.used_indices is not None:
+            log.fatal("Cannot add features to/from a Dataset returned by "
+                      "subset()")
         for ds, tag in ((self, "self"), (other, "other")):
             if ds.data is None:
                 log.fatal(f"Cannot add features from {tag} with freed raw "
@@ -357,8 +367,16 @@ class Dataset:
             cf = ds.categorical_feature
             if cf in ("auto", None):
                 return []
-            return [c if isinstance(c, str) else int(c) + offset
-                    for c in cf]
+            out = []
+            for c in cf:
+                if isinstance(c, str):
+                    if ds.feature_name == "auto":
+                        log.fatal("Cannot merge a name-based "
+                                  "categorical_feature without feature "
+                                  "names")
+                    c = list(ds.feature_name).index(c)
+                out.append(int(c) + offset)
+            return out
         if not (self.categorical_feature in ("auto", None)
                 and other.categorical_feature in ("auto", None)):
             self.categorical_feature = (_cats(self, 0)
@@ -450,7 +468,7 @@ class Booster:
                         value: float) -> "Booster":
         """ref: basic.py set_leaf_output / LGBM_BoosterSetLeafValue."""
         self._gbdt._sync_model()
-        self._gbdt.models_[tree_id].leaf_value[leaf_id] = float(value)
+        self._gbdt.models_[tree_id].set_leaf_output(leaf_id, float(value))
         return self
 
     def get_split_value_histogram(self, feature, bins=None,
@@ -468,9 +486,10 @@ class Booster:
                         and tree.decision_type[i] & 1 == 0):  # numerical
                     values.append(float(tree.threshold[i]))
         values = np.asarray(values, np.float64)
+        n_unique = len(np.unique(values))
         if bins is None or (isinstance(bins, int)
-                            and bins > max(len(values), 1)):
-            bins = max(len(values), 1)
+                            and bins > max(n_unique, 1)):
+            bins = max(n_unique, 1)
         hist, bin_edges = np.histogram(values, bins=bins)
         if xgboost_style:
             ret = np.column_stack((bin_edges[1:], hist))

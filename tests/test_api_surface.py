@@ -123,7 +123,6 @@ def test_dataset_field_accessors():
     assert ds.get_data() is X
     assert ds.get_feature_name() == ds.feature_names()
     assert ds.feature_num_bin(0) > 1
-    ref = lgb.Dataset(X, label=y)
     chain = ds.create_valid(X, label=y).get_ref_chain()
     assert ds in chain
 
@@ -171,3 +170,23 @@ def test_get_data_subset_and_freed():
     ds2.construct()
     with pytest.raises(lgb.LightGBMError, match="free_raw_data=False"):
         ds2.get_data()
+
+
+def test_ref_chain_cycle_terminates():
+    X, y = _data(n=100)
+    a = lgb.Dataset(X, label=y)
+    b_ds = lgb.Dataset(X, label=y)
+    a.reference = b_ds
+    b_ds.reference = a
+    chain = a.get_ref_chain()
+    assert chain == {a, b_ds}
+
+
+def test_subset_mutators_rejected():
+    X, y = _data(n=200)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    sub = ds.subset([1, 2, 3])
+    with pytest.raises(lgb.LightGBMError, match="subset"):
+        sub.set_categorical_feature([0])
+    with pytest.raises(lgb.LightGBMError, match="subset"):
+        sub.add_features_from(lgb.Dataset(X[:3], free_raw_data=False))
