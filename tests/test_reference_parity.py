@@ -29,6 +29,19 @@ PARAMS = dict(objective="binary", num_leaves=15, learning_rate=0.1,
               feature_fraction=1.0, bagging_fraction=1.0)
 
 
+def _oracle_predict(tmp_path, model, data_file, tag="pred"):
+    """Run the oracle CLI predictor and return its output."""
+    pred_conf = tmp_path / f"{tag}.conf"
+    pred_out = tmp_path / f"{tag}_out.txt"
+    pred_conf.write_text(
+        f"task = predict\ndata = {data_file}\ninput_model = {model}\n"
+        f"output_result = {pred_out}\nverbosity = -1\n")
+    r = subprocess.run([ORACLE, f"config={pred_conf}"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return np.loadtxt(pred_out)
+
+
 def _run_oracle(tmp_path, extra=""):
     conf = tmp_path / "train.conf"
     model = tmp_path / "model.txt"
@@ -38,15 +51,7 @@ def _run_oracle(tmp_path, extra=""):
     r = subprocess.run([ORACLE, f"config={conf}"], capture_output=True,
                        text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    pred_conf = tmp_path / "pred.conf"
-    pred_out = tmp_path / "pred.txt"
-    pred_conf.write_text(
-        f"task = predict\ndata = {TEST}\ninput_model = {model}\n"
-        f"output_result = {pred_out}\n")
-    r = subprocess.run([ORACLE, f"config={pred_conf}"], capture_output=True,
-                       text=True, timeout=300)
-    assert r.returncode == 0, r.stdout + r.stderr
-    return model, np.loadtxt(pred_out)
+    return model, _oracle_predict(tmp_path, model, TEST)
 
 
 @pytest.fixture(scope="module")
@@ -120,15 +125,7 @@ def test_model_interop_all_objectives(tmp_path, example, objective, extra):
     r = subprocess.run([ORACLE, f"config={conf}"], capture_output=True,
                        text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    pred_conf = tmp_path / "pred.conf"
-    pred_out = tmp_path / "pred.txt"
-    pred_conf.write_text(
-        f"task = predict\ndata = {test_file}\ninput_model = {model}\n"
-        f"output_result = {pred_out}\n")
-    r = subprocess.run([ORACLE, f"config={pred_conf}"],
-                       capture_output=True, text=True, timeout=300)
-    assert r.returncode == 0, r.stdout + r.stderr
-    ref_pred = np.loadtxt(pred_out)
+    ref_pred = _oracle_predict(tmp_path, model, test_file)
 
     booster = lgb.Booster(model_file=str(model))
     from lightgbm_tpu.io.parser import parse_file
@@ -154,3 +151,38 @@ def test_first_tree_root_split_matches(oracle_run):
     assert our_tree.split_feature[0] == ref_tree.split_feature[0]
     np.testing.assert_allclose(our_tree.threshold[0], ref_tree.threshold[0],
                                rtol=1e-10)
+
+
+@pytest.mark.parametrize("objective,extra_params", [
+    ("binary", {}),
+    ("regression", {}),
+    ("multiclass", {"num_class": 3}),
+])
+def test_reverse_interop_reference_reads_our_models(tmp_path, objective,
+                                                    extra_params):
+    """The OTHER direction: a model trained and saved by lightgbm_tpu must
+    load in REAL LightGBM and reproduce our predictions through its CLI
+    predictor (the v4 text format is a two-way contract; ref:
+    gbdt_model_text.cpp LoadModelFromString)."""
+    rng = np.random.RandomState(5)
+    n, F = 1200, 6
+    X = rng.rand(n, F)
+    if objective == "multiclass":
+        y = (X[:, 0] * 3).astype(int).clip(0, 2).astype(float)
+    elif objective == "binary":
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0.6).astype(float)
+    else:
+        y = X[:, 0] + 0.5 * X[:, 1] + 0.05 * rng.randn(n)
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, **extra_params}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    ours = b.predict(X)
+    model = tmp_path / "ours.txt"
+    b.save_model(str(model))
+
+    # the oracle predicts from a TSV data file (label col 0)
+    data_file = tmp_path / "data.tsv"
+    np.savetxt(data_file, np.column_stack([y, X]), delimiter="\t")
+    ref_pred = _oracle_predict(tmp_path, model, data_file)
+    np.testing.assert_allclose(ref_pred.reshape(ours.shape), ours,
+                               rtol=1e-5, atol=1e-6)
