@@ -407,6 +407,7 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._train_set = train_set
         self.name_valid_sets: List[str] = []
+        self._valid_wrappers: List[Dataset] = []
         if train_set is not None:
             cfg = Config(self.params)
             train_set.params = {**self.params, **train_set.params}
@@ -430,6 +431,7 @@ class Booster:
         cfg = self._gbdt.config
         self._gbdt.add_valid_data(core, name, create_metrics(cfg))
         self.name_valid_sets.append(name)
+        self._valid_wrappers.append(data)
         return self
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -527,6 +529,7 @@ class Booster:
         the live GBDT holds device arrays and jitted closures."""
         state = self.__dict__.copy()
         state.pop("_train_set", None)
+        state.pop("_valid_wrappers", None)  # hold raw data arrays
         gbdt = state.pop("_gbdt", None)
         state["_model_str"] = (save_model_to_string(gbdt)
                                if gbdt is not None else None)
@@ -538,6 +541,7 @@ class Booster:
         self._train_set = None
         # the restored GBDT is predictor-mode: no valid-set machinery
         self.name_valid_sets = []
+        self._valid_wrappers = []
         self._gbdt = (load_model_from_string(model_str)
                       if model_str is not None else None)
 
@@ -744,7 +748,10 @@ class Booster:
             else:
                 sc = self._gbdt.valid_scores[valid_idx]
                 score = sc[0] if sc.shape[0] == 1 else sc
-                dset = None
+                # the Dataset wrapper so feval can read labels/weights
+                # (ref: basic.py __inner_eval passes the valid Dataset)
+                dset = (self._valid_wrappers[valid_idx]
+                        if valid_idx < len(self._valid_wrappers) else None)
             res = feval(score, dset)
             if res:
                 if not isinstance(res[0], (list, tuple)):

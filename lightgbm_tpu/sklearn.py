@@ -119,11 +119,45 @@ class LGBMModel(_SKBase):
     def _fit(self, X, y, sample_weight=None, group=None, eval_set=None,
              eval_names=None, eval_sample_weight=None, eval_group=None,
              callbacks: Optional[List[Callable]] = None,
-             categorical_feature="auto") -> "LGBMModel":
+             categorical_feature="auto", init_score=None,
+             eval_init_score=None, eval_metric=None,
+             feature_name="auto") -> "LGBMModel":
+        if hasattr(X, "columns"):
+            self._feature_names_in = list(map(str, X.columns))
+            if feature_name == "auto":
+                feature_name = self._feature_names_in
+        else:
+            self._feature_names_in = None
         X = X.values if hasattr(X, "values") else np.asarray(X)
         y = np.asarray(y, dtype=np.float64).ravel()
         params = self._lgb_params()
+        feval = None
+        if eval_metric is not None:
+            # ref: sklearn.py fit: string metrics merge with the params
+            # metric; callables become custom feval functions
+            ems = (eval_metric if isinstance(eval_metric, (list, tuple))
+                   else [eval_metric])
+            names = [m for m in ems if isinstance(m, str)]
+            fevals = [m for m in ems if callable(m)]
+            if names:
+                base = params.get("metric", [])
+                if isinstance(base, str):
+                    base = [b for b in base.split(",") if b]
+                params["metric"] = list(base) + [m for m in names
+                                                 if m not in base]
+            if fevals:
+                if len(fevals) == 1:
+                    feval = fevals[0]
+                else:
+                    def feval(preds, ds, _fs=tuple(fevals)):
+                        out = []
+                        for f in _fs:
+                            r = f(preds, ds)
+                            out.extend(r if isinstance(r, list) else [r])
+                        return out
         train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score,
+                            feature_name=feature_name,
                             categorical_feature=categorical_feature)
         valid_sets, valid_names = [], []
         if eval_set:
@@ -132,20 +166,30 @@ class LGBMModel(_SKBase):
                 vw = (eval_sample_weight[i]
                       if eval_sample_weight is not None else None)
                 vg = eval_group[i] if eval_group is not None else None
-                if (vX is X or (vX.shape == X.shape
-                                and np.shares_memory(vX, X))):
+                vi = (eval_init_score[i]
+                      if eval_init_score is not None else None)
+                vy_arr = np.asarray(vy, np.float64).ravel()
+                if (vX is X and np.array_equal(vy_arr, y)
+                        and vw is None and vi is None):
+                    # the eval set IS the train set (data and labels)
                     valid_sets.append(train_set)
                 else:
                     valid_sets.append(Dataset(
-                        vX, label=np.asarray(vy, np.float64).ravel(),
-                        weight=vw, group=vg, reference=train_set))
+                        vX, label=vy_arr,
+                        weight=vw, group=vg, init_score=vi,
+                        reference=train_set))
                 valid_names.append(eval_names[i] if eval_names else
                                    f"valid_{i}")
+        self._evals_result = {}
+        cbs = list(callbacks or [])
+        if valid_sets:
+            from .callback import record_evaluation
+            cbs.append(record_evaluation(self._evals_result))
         self._Booster = train_api(params, train_set,
                                   num_boost_round=self.n_estimators,
                                   valid_sets=valid_sets or None,
                                   valid_names=valid_names or None,
-                                  callbacks=callbacks)
+                                  feval=feval, callbacks=cbs or None)
         self._n_features = X.shape[1]
         self.fitted_ = True
         return self
@@ -195,28 +239,67 @@ class LGBMModel(_SKBase):
         self._check_fitted()
         return self._Booster._gbdt.feature_importance(self.importance_type)
 
+    @property
+    def evals_result_(self):
+        self._check_fitted()
+        return self._evals_result
+
+    @property
+    def feature_name_(self) -> List[str]:
+        self._check_fitted()
+        return self._Booster.feature_name()
+
+    @property
+    def feature_names_in_(self):
+        """sklearn-style input feature names (pandas columns)."""
+        self._check_fitted()
+        if self._feature_names_in is None:
+            raise AttributeError(
+                "feature_names_in_ is defined only when X has column names")
+        return np.asarray(self._feature_names_in, dtype=object)
+
+    @property
+    def n_estimators_(self) -> int:
+        """Actual number of fitted iterations (<= n_estimators when early
+        stopping fires; ref: sklearn.py n_estimators_)."""
+        self._check_fitted()
+        bi = self._Booster.best_iteration
+        return bi if bi > 0 else self._Booster.current_iteration()
+
+    n_iter_ = n_estimators_
+
+    @property
+    def objective_(self) -> str:
+        self._check_fitted()
+        return self.objective
+
 
 class LGBMRegressor(_SKRegressor, LGBMModel):
     """ref: sklearn.py LGBMRegressor."""
 
-    def fit(self, X, y, sample_weight=None, eval_set=None, eval_names=None,
-            eval_sample_weight=None, callbacks=None,
-            categorical_feature="auto"):
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_init_score=None,
+            eval_metric=None, callbacks=None,
+            feature_name="auto", categorical_feature="auto"):
         if self.objective is None:
             self.objective = "regression"
         return self._fit(X, y, sample_weight=sample_weight,
-                         eval_set=eval_set, eval_names=eval_names,
+                         init_score=init_score, eval_set=eval_set,
+                         eval_names=eval_names,
                          eval_sample_weight=eval_sample_weight,
-                         callbacks=callbacks,
+                         eval_init_score=eval_init_score,
+                         eval_metric=eval_metric, callbacks=callbacks,
+                         feature_name=feature_name,
                          categorical_feature=categorical_feature)
 
 
 class LGBMClassifier(_SKClassifier, LGBMModel):
     """ref: sklearn.py LGBMClassifier."""
 
-    def fit(self, X, y, sample_weight=None, eval_set=None, eval_names=None,
-            eval_sample_weight=None, callbacks=None,
-            categorical_feature="auto"):
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_init_score=None,
+            eval_metric=None, callbacks=None,
+            feature_name="auto", categorical_feature="auto"):
         y = np.asarray(y).ravel()
         self.classes_, y_enc = np.unique(y, return_inverse=True)
         self.n_classes_ = len(self.classes_)
@@ -233,10 +316,13 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
                 vy = np.asarray([lut[v] for v in np.asarray(vy).ravel()])
                 enc_eval.append((vX, vy))
         return self._fit(X, y_enc.astype(np.float64),
-                         sample_weight=sample_weight, eval_set=enc_eval,
+                         sample_weight=sample_weight,
+                         init_score=init_score, eval_set=enc_eval,
                          eval_names=eval_names,
                          eval_sample_weight=eval_sample_weight,
-                         callbacks=callbacks,
+                         eval_init_score=eval_init_score,
+                         eval_metric=eval_metric, callbacks=callbacks,
+                         feature_name=feature_name,
                          categorical_feature=categorical_feature)
 
     def predict_proba(self, X, raw_score: bool = False,
@@ -263,16 +349,20 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
 class LGBMRanker(LGBMModel):
     """ref: sklearn.py LGBMRanker (lambdarank)."""
 
-    def fit(self, X, y, group, sample_weight=None, eval_set=None,
-            eval_names=None, eval_sample_weight=None, eval_group=None,
+    def fit(self, X, y, group, sample_weight=None, init_score=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
             eval_at=(1, 2, 3, 4, 5), callbacks=None,
-            categorical_feature="auto"):
+            feature_name="auto", categorical_feature="auto"):
         if self.objective is None:
             self.objective = "lambdarank"
         self._other_params.setdefault(
             "eval_at", ",".join(str(a) for a in eval_at))
         return self._fit(X, y, sample_weight=sample_weight, group=group,
-                         eval_set=eval_set, eval_names=eval_names,
+                         init_score=init_score, eval_set=eval_set,
+                         eval_names=eval_names,
                          eval_sample_weight=eval_sample_weight,
-                         eval_group=eval_group, callbacks=callbacks,
+                         eval_init_score=eval_init_score,
+                         eval_group=eval_group, eval_metric=eval_metric,
+                         callbacks=callbacks, feature_name=feature_name,
                          categorical_feature=categorical_feature)

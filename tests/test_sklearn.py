@@ -138,3 +138,63 @@ def test_pickled_booster_eval_valid_safe():
     assert b2.eval_valid() == []
     res = b2.eval(lgb.Dataset(X, label=y), "new")
     assert res and np.isfinite(res[0][2])
+
+
+def test_sklearn_new_fit_params_and_attrs():
+    """eval_metric / init_score / evals_result_ / feature_name_ /
+    n_estimators_ / objective_ (ref: sklearn.py fit + fitted attrs)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(500, 4)
+    y = X[:, 0] + 0.2 * rng.randn(500)
+    reg = lgb.LGBMRegressor(n_estimators=6, num_leaves=7,
+                            min_child_samples=5)
+    reg.fit(X, y, eval_set=[(X[:100], y[:100])], eval_metric="l1",
+            init_score=np.zeros(len(y)),
+            feature_name=["a", "b", "c", "d"])
+    assert "l1" in next(iter(reg.evals_result_.values()))
+    assert reg.feature_name_ == ["a", "b", "c", "d"]
+    assert reg.n_estimators_ == 6 and reg.n_iter_ == 6
+    assert reg.objective_ == "regression"
+
+
+def test_sklearn_feature_names_in_from_pandas():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame(rng.rand(300, 3), columns=["f1", "f2", "f3"])
+    y = df["f1"] + 0.1 * rng.randn(300)
+    reg = lgb.LGBMRegressor(n_estimators=3, num_leaves=7,
+                            min_child_samples=5).fit(df, y)
+    assert list(reg.feature_names_in_) == ["f1", "f2", "f3"]
+    assert reg.feature_name_ == ["f1", "f2", "f3"]
+
+
+def test_eval_metric_merges_and_callable_feval():
+    """eval_metric strings merge with the configured metric; callables
+    route to feval (ref: sklearn.py _EvalFunctionWrapper)."""
+    rng = np.random.RandomState(1)
+    X = rng.rand(400, 3)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+
+    def my_metric(preds, ds):
+        return ("my_mae", float(np.mean(np.abs(preds - ds.get_label()))),
+                False)
+
+    reg = lgb.LGBMRegressor(n_estimators=4, num_leaves=7,
+                            min_child_samples=5, metric="rmse")
+    reg.fit(X, y, eval_set=[(X[:100], y[:100])],
+            eval_metric=["l1", my_metric])
+    res = next(iter(reg.evals_result_.values()))
+    assert "rmse" in res and "l1" in res and "my_mae" in res, res.keys()
+
+
+def test_eval_set_aliasing_train_uses_own_labels():
+    """eval_set=(X, other_y) must NOT silently reuse the train labels."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(300, 3)
+    y = X[:, 0] + 0.05 * rng.randn(300)
+    y_shifted = y + 100.0
+    reg = lgb.LGBMRegressor(n_estimators=3, num_leaves=7,
+                            min_child_samples=5)
+    reg.fit(X, y, eval_set=[(X, y_shifted)], eval_metric="l1")
+    l1 = next(iter(reg.evals_result_.values()))["l1"][-1]
+    assert l1 > 50, l1  # evaluated against the SHIFTED labels
