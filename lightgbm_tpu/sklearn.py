@@ -169,9 +169,12 @@ class LGBMModel(_SKBase):
                 vi = (eval_init_score[i]
                       if eval_init_score is not None else None)
                 vy_arr = np.asarray(vy, np.float64).ravel()
-                if (vX is X and np.array_equal(vy_arr, y)
-                        and vw is None and vi is None):
-                    # the eval set IS the train set (data and labels)
+                same_data = vX is X or (vX.shape == X.shape
+                                        and np.shares_memory(vX, X))
+                if (same_data and np.array_equal(vy_arr, y)
+                        and vw is None and vi is None and vg is None):
+                    # the eval set IS the train set (data, labels, and no
+                    # overriding weight/init/group)
                     valid_sets.append(train_set)
                 else:
                     valid_sets.append(Dataset(

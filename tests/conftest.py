@@ -18,3 +18,28 @@ jax.config.update("jax_platforms", "cpu")
 # are reused across pytest runs
 jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+import pytest
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    """The remote-TPU (axon) plugin can segfault during interpreter
+    teardown AFTER every test finished and the summary printed, flipping
+    pytest's exit code to 139.  Exit with the real status instead of
+    running interpreter shutdown."""
+    import os
+    import sys
+    status = getattr(config, "_lgbt_exitstatus", None)
+    if status is None:
+        # no session ran (usage/startup error): keep normal teardown so
+        # pytest's own exit code (e.g. 4) is preserved
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(int(status))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    session.config._lgbt_exitstatus = exitstatus
