@@ -169,8 +169,14 @@ class LGBMModel(_SKBase):
                 vi = (eval_init_score[i]
                       if eval_init_score is not None else None)
                 vy_arr = np.asarray(vy, np.float64).ravel()
-                same_data = vX is X or (vX.shape == X.shape
-                                        and np.shares_memory(vX, X))
+                # identical ndarray OR the same view (same start address,
+                # shape, and strides — shares_memory alone also matches
+                # overlapping/rearranged views, which are NOT the train set)
+                same_data = vX is X or (
+                    vX.shape == X.shape
+                    and vX.strides == X.strides
+                    and vX.__array_interface__["data"][0]
+                    == X.__array_interface__["data"][0])
                 if (same_data and np.array_equal(vy_arr, y)
                         and vw is None and vi is None and vg is None):
                     # the eval set IS the train set (data, labels, and no

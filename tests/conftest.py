@@ -29,13 +29,18 @@ def pytest_unconfigure(config):
     teardown AFTER every test finished and the summary printed, flipping
     pytest's exit code to 139.  Exit with the real status instead of
     running interpreter shutdown."""
+    import atexit
     import os
     import sys
     status = getattr(config, "_lgbt_exitstatus", None)
-    if status is None:
-        # no session ran (usage/startup error): keep normal teardown so
-        # pytest's own exit code (e.g. 4) is preserved
+    if status is None or os.environ.get("LGBT_KEEP_TEARDOWN") == "1":
+        # no session ran (usage/startup error) or explicitly opted out:
+        # keep normal teardown so pytest's own exit code is preserved
         return
+    try:
+        atexit._run_exitfuncs()  # coverage/profiler finalizers still run
+    except Exception:
+        pass
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(int(status))
