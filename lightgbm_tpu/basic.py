@@ -471,6 +471,8 @@ class Booster:
         """ref: basic.py set_leaf_output / LGBM_BoosterSetLeafValue."""
         self._gbdt._sync_model()
         self._gbdt.models_[tree_id].set_leaf_output(leaf_id, float(value))
+        self._gbdt._model_mutations = getattr(
+            self._gbdt, "_model_mutations", 0) + 1  # invalidate pred cache
         return self
 
     def get_split_value_histogram(self, feature, bins=None,
@@ -647,6 +649,7 @@ class Booster:
         end = total if end_iteration < 0 else min(end_iteration, total)
         idx = np.arange(start_iteration, end)
         np.random.RandomState(g.config.seed).shuffle(idx)
+        g._model_mutations = getattr(g, "_model_mutations", 0) + 1
         blocks = [g.models_[i * K:(i + 1) * K] for i in range(total)]
         reordered = blocks[:start_iteration] + [blocks[i] for i in idx] \
             + blocks[end:]

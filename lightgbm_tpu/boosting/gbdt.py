@@ -1264,8 +1264,26 @@ class GBDT:
         if num_iteration < 0:
             num_iteration = total_iters - start_iteration
         end = min(start_iteration + num_iteration, total_iters)
-        out = np.zeros((K, n))
         use_es = pred_early_stop and not self.average_output_
+        if not use_es and end > start_iteration:
+            # native batch predictor (OpenMP over rows; ref:
+            # src/application/predictor.hpp) — Python path on fallback.
+            # The flattened pack is cached per model slice and invalidated
+            # by growth/mutation (set_leaf_output etc. bump the counter).
+            from ..native import PackedPredictor, predictor_lib
+            if predictor_lib() is not None:
+                key = (start_iteration, end, len(self.models_),
+                       getattr(self, "_model_mutations", 0))
+                cached = getattr(self, "_packed_pred", None)
+                if cached is None or cached[0] != key:
+                    packed = PackedPredictor(
+                        self.models_[start_iteration * K:end * K])
+                    cached = (key, packed)
+                    self._packed_pred = cached
+                res = cached[1].predict(X, K, self.average_output_)
+                if res is not None:
+                    return res[:, 0] if K == 1 else res
+        out = np.zeros((K, n))
         active_idx = np.arange(n) if use_es else None
         Xa = X
         for i, it in enumerate(range(start_iteration, end)):
@@ -1374,6 +1392,7 @@ class GBDT:
         """Refit the existing tree structures' leaf values to new data
         (ref: gbdt.cpp:252 RefitTree; serial_tree_learner.cpp:241
         FitByExistingTree: new_leaf = decay*old + (1-decay)*output*shrink)."""
+        self._model_mutations = getattr(self, "_model_mutations", 0) + 1
         self._sync_model()
         import jax.numpy as jnp_
         from ..io.dataset import Metadata

@@ -31,30 +31,142 @@ def _cache_dir() -> str:
     return d
 
 
-def _compile(src_path: str, tag: str) -> Optional[str]:
-    """Compile src to a cached shared library; returns its path or None."""
+def _compile(src_path: str, tag: str,
+             extra_flags: tuple = ()) -> Optional[str]:
+    """Compile src to a cached shared library; returns its path or None.
+    extra_flags are best-effort: compilation retries without them."""
     with open(src_path, "rb") as f:
         src = f.read()
-    h = hashlib.sha256(src).hexdigest()[:16]
+    h = hashlib.sha256(src + repr(extra_flags).encode()).hexdigest()[:16]
     out = os.path.join(_cache_dir(), f"lib{tag}-{h}.so")
     if os.path.exists(out):
         return out
-    for cc in ("cc", "gcc", "g++", "clang"):
-        try:
-            tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
-            r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src_path, "-lm"],
-                capture_output=True, timeout=120)
-            if r.returncode == 0:
-                os.replace(tmp, out)
-                return out
-        except (OSError, subprocess.TimeoutExpired):
-            continue
+    for flags in ((*extra_flags,), ()) if extra_flags else ((),):
+        for cc in ("cc", "gcc", "g++", "clang"):
+            try:
+                tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
+                r = subprocess.run(
+                    [cc, "-O2", "-shared", "-fPIC", *flags, "-o", tmp,
+                     src_path, "-lm"],
+                    capture_output=True, timeout=120)
+                if r.returncode == 0:
+                    os.replace(tmp, out)
+                    return out
+            except (OSError, subprocess.TimeoutExpired):
+                continue
     return None
 
 
 _parser_lib = None
 _parser_tried = False
+_pred_lib = None
+_pred_tried = False
+
+
+def predictor_lib():
+    """The compiled batch predictor (OpenMP over rows when the compiler
+    supports it; ref: src/application/predictor.hpp)."""
+    global _pred_lib, _pred_tried
+    if _pred_tried:
+        return _pred_lib
+    _pred_tried = True
+    path = _compile(os.path.join(_SRC_DIR, "predict.c"), "predict",
+                    extra_flags=("-fopenmp",))
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:  # stale/foreign cached .so: fall back to Python
+        return None
+    c_dbl = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    c_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    c_i8 = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+    c_u32 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    c_long = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.lgbt_predict_batch.argtypes = [
+        c_dbl, ctypes.c_long, ctypes.c_long,
+        c_i32, c_dbl, c_i8, c_i32, c_i32, c_dbl, c_u32, c_i32,
+        c_long, c_long, c_long, c_long,
+        ctypes.c_long, ctypes.c_long, ctypes.c_int, c_dbl]
+    lib.lgbt_predict_batch.restype = None
+    _pred_lib = lib
+    return lib
+
+
+class PackedPredictor:
+    """Flattened tree arrays for repeated native predict calls (the
+    packing is O(model size); callers cache one per model slice)."""
+
+    def __init__(self, trees):
+        self.ok = not any(getattr(t, "is_linear", False) for t in trees)
+        if not self.ok:
+            return
+        self._pack(trees)
+
+    def _pack(self, trees):
+        self.T = len(trees)
+        sf, th, dt, lc, rc, lv, cw, cb = [], [], [], [], [], [], [], []
+        node_off = [0]
+        leaf_off = [0]
+        cw_off = [0]
+        cb_off = [0]
+        for t in trees:
+            nl = t.num_leaves
+            ni = max(nl - 1, 0)
+            sf.append(np.asarray(t.split_feature[:ni], np.int32))
+            th.append(np.asarray(t.threshold[:ni], np.float64))
+            dt.append(np.asarray(t.decision_type[:ni], np.int8))
+            lc.append(np.asarray(t.left_child[:ni], np.int32))
+            rc.append(np.asarray(t.right_child[:ni], np.int32))
+            lv.append(np.asarray(t.leaf_value[:max(nl, 1)], np.float64))
+            words = np.asarray(t.cat_threshold, np.uint32)
+            bounds = np.asarray(t.cat_boundaries, np.int32)
+            cw.append(words)
+            cb.append(bounds)
+            node_off.append(node_off[-1] + ni)
+            leaf_off.append(leaf_off[-1] + max(nl, 1))
+            cw_off.append(cw_off[-1] + len(words))
+            cb_off.append(cb_off[-1] + len(bounds))
+
+        def cat(parts, dtype):
+            return (np.concatenate(parts) if parts
+                    else np.zeros(0, dtype)).astype(dtype)
+        self.sf = cat(sf, np.int32)
+        self.th = cat(th, np.float64)
+        self.dt = cat(dt, np.int8)
+        self.lc = cat(lc, np.int32)
+        self.rc = cat(rc, np.int32)
+        self.lv = cat(lv, np.float64)
+        self.cw = cat(cw, np.uint32)
+        self.cb = cat(cb, np.int32)
+        self.node_off = np.asarray(node_off, np.int64)
+        self.leaf_off = np.asarray(leaf_off, np.int64)
+        self.cw_off = np.asarray(cw_off, np.int64)
+        self.cb_off = np.asarray(cb_off, np.int64)
+
+    def predict(self, X: np.ndarray, K: int,
+                average: bool) -> Optional[np.ndarray]:
+        lib = predictor_lib()
+        if lib is None or not self.ok:
+            return None
+        X = np.ascontiguousarray(X, np.float64)
+        n = X.shape[0]
+        out = np.zeros((n, K), np.float64)
+        lib.lgbt_predict_batch(
+            X, n, X.shape[1], self.sf, self.th, self.dt, self.lc, self.rc,
+            self.lv, self.cw, self.cb, self.node_off, self.leaf_off,
+            self.cw_off, self.cb_off, self.T, K, int(bool(average)), out)
+        return out
+
+
+def predict_batch_native(trees, X: np.ndarray, K: int,
+                         average: bool) -> Optional[np.ndarray]:
+    """One-shot native prediction (packs then predicts); callers with
+    repeated predicts should cache a PackedPredictor instead."""
+    if predictor_lib() is None:
+        return None
+    packed = PackedPredictor(trees)
+    return packed.predict(X, K, average) if packed.ok else None
 
 
 def parser_lib():
@@ -67,7 +179,10 @@ def parser_lib():
     path = _compile(os.path.join(_SRC_DIR, "parser.c"), "parser")
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
     c_dbl_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
     lib.lgbt_parse_dense.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_char, ctypes.c_long,
@@ -126,7 +241,10 @@ def treeshap_lib():
     path = _compile(os.path.join(_SRC_DIR, "treeshap.c"), "treeshap")
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
     c_int_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     c_dbl_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
     c_i8_p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")

@@ -1,0 +1,121 @@
+"""Native batch predictor vs the Python traversal (ref:
+src/application/predictor.hpp — the reference's batch predictor is
+native too).  Must be bit-identical: same doubles, same missing/
+categorical routing."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.native as N
+
+pytestmark = pytest.mark.skipif(N.predictor_lib() is None,
+                                reason="no C compiler available")
+
+
+def _predict_both(booster, X):
+    p_native = booster.predict(X)
+    orig = N.predict_batch_native
+    N.predict_batch_native = lambda *a, **k: None
+    try:
+        p_py = booster.predict(X)
+    finally:
+        N.predict_batch_native = orig
+    return p_native, p_py
+
+
+def test_native_predict_binary_nan_categorical():
+    rng = np.random.RandomState(0)
+    X = rng.rand(3000, 6)
+    X[rng.rand(*X.shape) < 0.1] = np.nan
+    X[:, 3] = rng.randint(0, 8, len(X))
+    y = ((np.nan_to_num(X[:, 0]) > 0.5)
+         | np.isin(X[:, 3], [1, 5])).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 31, "verbosity": -1,
+                   "categorical_feature": [3], "use_missing": True,
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    p_n, p_p = _predict_both(b, X)
+    np.testing.assert_array_equal(p_n, p_p)
+
+
+def test_native_predict_multiclass_and_rf():
+    rng = np.random.RandomState(1)
+    X = rng.rand(2000, 5)
+    y = (X[:, 0] * 3).astype(int).clip(0, 2).astype(float)
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   "num_leaves": 15, "verbosity": -1,
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    p_n, p_p = _predict_both(b, X)
+    np.testing.assert_array_equal(p_n, p_p)
+    # RF averages raw scores (average_output_)
+    b_rf = lgb.train({"objective": "binary", "boosting": "rf",
+                      "bagging_freq": 1, "bagging_fraction": 0.7,
+                      "num_leaves": 15, "verbosity": -1,
+                      "min_data_in_leaf": 5},
+                     lgb.Dataset(X, label=(y > 1).astype(float)),
+                     num_boost_round=6)
+    p_n, p_p = _predict_both(b_rf, X)
+    np.testing.assert_array_equal(p_n, p_p)
+
+
+def test_native_predict_start_num_iteration():
+    rng = np.random.RandomState(2)
+    X = rng.rand(1000, 4)
+    y = X[:, 0] + 0.1 * rng.randn(1000)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    for kw in ({"start_iteration": 2, "num_iteration": 3},
+               {"num_iteration": 5},):
+        p_n = b.predict(X, **kw)
+        orig = N.predict_batch_native
+        N.predict_batch_native = lambda *a, **k: None
+        try:
+            p_p = b.predict(X, **kw)
+        finally:
+            N.predict_batch_native = orig
+        np.testing.assert_array_equal(p_n, p_p)
+
+
+def test_linear_tree_falls_back_to_python():
+    rng = np.random.RandomState(3)
+    X = rng.rand(1500, 4)
+    y = 2 * X[:, 0] + X[:, 1] + 0.05 * rng.randn(1500)
+    b = lgb.train({"objective": "regression", "linear_tree": True,
+                   "num_leaves": 15, "verbosity": -1,
+                   "min_data_in_leaf": 20},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    assert np.isfinite(b.predict(X)).all()
+
+
+def test_set_leaf_output_invalidates_packed_cache():
+    rng = np.random.RandomState(4)
+    X = rng.rand(800, 3)
+    y = X[:, 0] + 0.05 * rng.randn(800)
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    p1 = b.predict(X)
+    v = b.get_leaf_output(0, 0)
+    b.set_leaf_output(0, 0, v + 5.0)
+    p2 = b.predict(X)
+    assert not np.allclose(p1, p2), "cached pack must be invalidated"
+
+
+def test_negative_fraction_categorical_matches_python():
+    """fv in (-1, 0) truncates to category 0 (int(v) semantics)."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(2000, 3)
+    X[:, 1] = rng.randint(0, 6, len(X))
+    y = np.isin(X[:, 1], [0, 2]).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "categorical_feature": [1],
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    Xq = X[:50].copy()
+    Xq[:, 1] = -0.5   # truncates to category 0
+    Xq[25:, 1] = -3.7  # negative -> right
+    p_n, p_p = _predict_both(b, Xq)
+    np.testing.assert_array_equal(p_n, p_p)
