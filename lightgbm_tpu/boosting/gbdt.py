@@ -1248,6 +1248,22 @@ class GBDT:
         return out
 
     # ---------------------------------------------------------------- predict
+    def _packed_for(self, start_iteration: int, end: int, K: int):
+        """Cached native PackedPredictor for a model slice, invalidated by
+        growth (len) and in-place mutation (_model_mutations)."""
+        from ..native import PackedPredictor, predictor_lib
+        if predictor_lib() is None:
+            return None
+        key = (start_iteration, end, len(self.models_),
+               getattr(self, "_model_mutations", 0))
+        cached = getattr(self, "_packed_pred", None)
+        if cached is None or cached[0] != key:
+            cached = (key, PackedPredictor(
+                self.models_[start_iteration * K:end * K]))
+            self._packed_pred = cached
+        packed = cached[1]
+        return packed if packed.ok else None
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, pred_early_stop: bool = False,
                     pred_early_stop_freq: int = 10,
@@ -1270,17 +1286,9 @@ class GBDT:
             # src/application/predictor.hpp) — Python path on fallback.
             # The flattened pack is cached per model slice and invalidated
             # by growth/mutation (set_leaf_output etc. bump the counter).
-            from ..native import PackedPredictor, predictor_lib
-            if predictor_lib() is not None:
-                key = (start_iteration, end, len(self.models_),
-                       getattr(self, "_model_mutations", 0))
-                cached = getattr(self, "_packed_pred", None)
-                if cached is None or cached[0] != key:
-                    packed = PackedPredictor(
-                        self.models_[start_iteration * K:end * K])
-                    cached = (key, packed)
-                    self._packed_pred = cached
-                res = cached[1].predict(X, K, self.average_output_)
+            packed = self._packed_for(start_iteration, end, K)
+            if packed is not None:
+                res = packed.predict(X, K, self.average_output_)
                 if res is not None:
                     return res[:, 0] if K == 1 else res
         out = np.zeros((K, n))
@@ -1475,6 +1483,14 @@ class GBDT:
         if num_iteration < 0:
             num_iteration = total_iters - start_iteration
         end = min(start_iteration + num_iteration, total_iters)
+        if end > start_iteration:
+            # same native traversal as predict, returning leaf ids;
+            # shares predict_raw's packed-model cache
+            packed = self._packed_for(start_iteration, end, K)
+            if packed is not None:
+                res = packed.predict_leaf(X)
+                if res is not None:
+                    return res
         cols = []
         for it in range(start_iteration, end):
             for k in range(K):

@@ -89,6 +89,13 @@ def predictor_lib():
         c_long, c_long, c_long, c_long,
         ctypes.c_long, ctypes.c_long, ctypes.c_int, c_dbl]
     lib.lgbt_predict_batch.restype = None
+    lib.lgbt_predict_leaf.argtypes = [
+        c_dbl, ctypes.c_long, ctypes.c_long,
+        c_i32, c_dbl, c_i8, c_i32, c_i32, c_u32, c_i32,
+        c_long, c_long, c_long,
+        ctypes.c_long,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+    lib.lgbt_predict_leaf.restype = None
     _pred_lib = lib
     return lib
 
@@ -143,6 +150,20 @@ class PackedPredictor:
         self.leaf_off = np.asarray(leaf_off, np.int64)
         self.cw_off = np.asarray(cw_off, np.int64)
         self.cb_off = np.asarray(cb_off, np.int64)
+
+    def predict_leaf(self, X: np.ndarray) -> Optional[np.ndarray]:
+        """[n, T] leaf indices, or None when unavailable."""
+        lib = predictor_lib()
+        if lib is None or not self.ok:
+            return None
+        X = np.ascontiguousarray(X, np.float64)
+        n = X.shape[0]
+        out = np.zeros((n, self.T), np.int32)
+        lib.lgbt_predict_leaf(
+            X, n, X.shape[1], self.sf, self.th, self.dt, self.lc, self.rc,
+            self.cw, self.cb, self.node_off, self.cw_off, self.cb_off,
+            self.T, out)
+        return out
 
     def predict(self, X: np.ndarray, K: int,
                 average: bool) -> Optional[np.ndarray]:

@@ -16,12 +16,14 @@
 #define MISSING_ZERO 1
 #define MISSING_NAN 2
 
-/* One tree's traversal for one row; mirrors models/tree.py _decision. */
-static double predict_one(const double *row, const int32_t *split_feature,
-                          const double *threshold, const int8_t *dtype,
-                          const int32_t *left, const int32_t *right,
-                          const double *leaf_value, const uint32_t *cat_words,
-                          const int32_t *cat_bound) {
+/* One tree's traversal for one row; mirrors models/tree.py _decision.
+ * Returns the LEAF index — the single source of routing semantics for
+ * both value prediction and pred_leaf. */
+static int32_t get_leaf_node(const double *row, const int32_t *split_feature,
+                             const double *threshold, const int8_t *dtype,
+                             const int32_t *left, const int32_t *right,
+                             const uint32_t *cat_words,
+                             const int32_t *cat_bound) {
   int32_t node = 0;
   while (node >= 0) {
     double fv = row[split_feature[node]];
@@ -53,7 +55,7 @@ static double predict_one(const double *row, const int32_t *split_feature,
     }
     node = go_left ? left[node] : right[node];
   }
-  return leaf_value[~node];
+  return ~node;
 }
 
 /* Sum T trees' outputs into out[n_rows * K] (class k = tree index % K).
@@ -81,15 +83,43 @@ void lgbt_predict_batch(const double *X, long n_rows, long n_cols,
         /* stump: single leaf */
         v = leaf_value[leaf_off[t]];
       } else {
-        v = predict_one(row, split_feature + node_off[t],
-                        threshold + node_off[t], dtype + node_off[t],
-                        left + node_off[t], right + node_off[t],
-                        leaf_value + leaf_off[t], cat_words + cat_word_off[t],
-                        cat_bound + cat_bound_off[t]);
+        int32_t leaf = get_leaf_node(
+            row, split_feature + node_off[t], threshold + node_off[t],
+            dtype + node_off[t], left + node_off[t], right + node_off[t],
+            cat_words + cat_word_off[t], cat_bound + cat_bound_off[t]);
+        v = leaf_value[leaf_off[t] + leaf];
       }
       out[r * K + k] += v;
     }
     if (average && iters > 0)
       for (long k = 0; k < K; ++k) out[r * K + k] /= (double)iters;
+  }
+}
+
+/* Leaf indices per (row, tree) into out_idx[n_rows * T]
+ * (ref: tree.h:422 GetLeaf; used by pred_leaf / refit). */
+void lgbt_predict_leaf(const double *X, long n_rows, long n_cols,
+                       const int32_t *split_feature, const double *threshold,
+                       const int8_t *dtype, const int32_t *left,
+                       const int32_t *right, const uint32_t *cat_words,
+                       const int32_t *cat_bound, const long *node_off,
+                       const long *cat_word_off, const long *cat_bound_off,
+                       long T, int32_t *out_idx) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (long r = 0; r < n_rows; ++r) {
+    const double *row = X + r * n_cols;
+    for (long t = 0; t < T; ++t) {
+      long base = node_off[t];
+      if (node_off[t + 1] - base <= 0) {
+        out_idx[r * T + t] = 0; /* stump */
+        continue;
+      }
+      out_idx[r * T + t] = get_leaf_node(
+          row, split_feature + base, threshold + base, dtype + base,
+          left + base, right + base, cat_words + cat_word_off[t],
+          cat_bound + cat_bound_off[t]);
+    }
   }
 }

@@ -119,3 +119,19 @@ def test_negative_fraction_categorical_matches_python():
     Xq[25:, 1] = -3.7  # negative -> right
     p_n, p_p = _predict_both(b, Xq)
     np.testing.assert_array_equal(p_n, p_p)
+
+
+def test_native_pred_leaf_matches_python():
+    rng = np.random.RandomState(6)
+    X = rng.rand(1500, 5)
+    X[rng.rand(*X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0.5).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "use_missing": True,
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    leaves_n = b.predict(X, pred_leaf=True)
+    # python oracle: per-tree get_leaf_index
+    b._gbdt._sync_model()
+    leaves_p = np.stack([t.get_leaf_index(X) for t in b._gbdt.models_], 1)
+    np.testing.assert_array_equal(leaves_n, leaves_p)
