@@ -14,10 +14,13 @@ import jax
 # so tests are fast (no tunnel round-trips) and deterministic
 jax.config.update("jax_platforms", "cpu")
 
-# persistent XLA compilation cache: grow_tree compiles (~20-60s each on CPU)
-# are reused across pytest runs
-jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# NO persistent XLA compilation cache: this environment has two Python
+# installs with different jaxlib builds, and the venv build SIGSEGVs both
+# when LOADING cache entries written by the other build
+# (backend_compile_and_load; the cpu_aot_loader machine-feature warnings
+# are the precursor) and when WRITING sharded pjit executables
+# (put_executable_and_time).  Cold compiles cost a few extra minutes per
+# run; a segfaulting test gate costs a round.
 
 
 import pytest
@@ -48,3 +51,14 @@ def pytest_unconfigure(config):
 
 def pytest_sessionfinish(session, exitstatus):
     session.config._lgbt_exitstatus = exitstatus
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """The venv jaxlib segfaults inside backend_compile_and_load once a
+    long-lived process has accumulated a few hundred compiled executables
+    (LLVM JIT lifetime state); clearing the jit caches between test
+    modules keeps the process below the threshold.  Costs recompiles for
+    configs shared across modules, which are rare."""
+    yield
+    jax.clear_caches()
