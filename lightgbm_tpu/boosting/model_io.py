@@ -127,8 +127,17 @@ def load_model_from_string(text: str):
             kv[k.strip()] = v.strip()
         elif line.strip() == "average_output":
             booster.average_output_ = True  # ref: gbdt_model_text.cpp:487
+    # the reference Log::Fatal's on unrecognized text ("Model format
+    # error"); a submodel header ("tree") must open the file
+    if not text.lstrip().startswith("tree"):
+        log.fatal("Unknown model format or submodel type in model file")
     if "version" not in kv:
         log.warning("Unknown model format version")
+    if not rest.strip() and "end of trees" not in text:
+        # zero-tree saves are valid (they carry the end-of-trees marker);
+        # header-only junk is not (ref: gbdt_model_text.cpp Log::Fatal)
+        log.fatal("Model file doesn't contain any trees "
+                  "(ref: gbdt_model_text.cpp 'Model format error')")
     num_class = int(kv.get("num_class", "1"))
     K = int(kv.get("num_tree_per_iteration", str(num_class)))
     booster.num_class = num_class

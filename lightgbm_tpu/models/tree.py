@@ -20,6 +20,7 @@ import numpy as np
 
 from ..io.binning import (K_ZERO_THRESHOLD, MISSING_NAN, MISSING_NONE,
                           MISSING_ZERO)
+from ..utils import log
 
 K_CATEGORICAL_MASK = 1
 K_DEFAULT_LEFT_MASK = 2
@@ -319,11 +320,24 @@ class Tree:
             if "=" in line:
                 k, v = line.split("=", 1)
                 kv[k.strip()] = v.strip()
+        if "num_leaves" not in kv:
+            log.fatal("Tree model string format error: missing num_leaves")
         nl = int(kv["num_leaves"])
         t = cls(max(nl, 2))
         t.num_leaves = nl
         t.num_cat = int(kv.get("num_cat", "0"))
         ni = max(nl - 1, 0)
+        # the reference fatals on trees without the required fields
+        # (ref: tree.cpp "Tree model should contain leaf_value field");
+        # leaf_value is required even for single-leaf trees, the split
+        # arrays only once a split exists
+        required = ["leaf_value"]
+        if nl > 1:
+            required += ["split_feature", "threshold", "left_child",
+                         "right_child"]
+        for req in required:
+            if req not in kv:
+                log.fatal(f"Tree model should contain {req} field")
 
         def read_arr(key, dtype, count):
             if count == 0 or key not in kv or kv[key] == "":

@@ -73,3 +73,53 @@ def test_json():
     j = t.to_json(0)
     assert j["num_leaves"] == 3
     assert j["tree_structure"]["split_feature"] == 0
+
+
+def test_model_loader_rejects_garbage_cleanly():
+    """Malformed model text must raise LightGBMError (or ValueError from
+    numeric parsing), never segfault or produce a silent half-model
+    (ref: gbdt_model_text.cpp LoadModelFromString's Log::Fatal paths)."""
+    import pytest
+    import lightgbm_tpu as lgb
+    cases = [
+        "",                                     # empty
+        "not a model at all",
+        "tree\nversion=v4\n",                   # headers only, no trees
+        "tree\nversion=v4\nnum_class=1\nTree=0\nnum_leaves=2\n",  # truncated tree
+    ]
+    for txt in cases:
+        with pytest.raises((lgb.LightGBMError, ValueError, KeyError,
+                            IndexError)):
+            lgb.Booster(model_str=txt)
+
+
+def test_model_roundtrip_after_garbage_attempt():
+    """A failed load must not poison subsequent valid loads."""
+    import lightgbm_tpu as lgb
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.rand(300, 3)
+    y = X[:, 0]
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+    txt = b.model_to_string()
+    try:
+        lgb.Booster(model_str="garbage")
+    except Exception:
+        pass
+    b2 = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(b2.predict(X), b.predict(X), rtol=1e-6)
+
+
+def test_zero_tree_model_roundtrips():
+    """Zero-iteration saves carry the end-of-trees marker and must load
+    (the garbage fatal only rejects marker-less header junk)."""
+    import lightgbm_tpu as lgb
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.rand(100, 3)
+    b = lgb.train({"objective": "regression", "verbosity": -1},
+                  lgb.Dataset(X, label=X[:, 0]), num_boost_round=0)
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    assert b2.num_trees() == 0
