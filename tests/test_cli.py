@@ -122,3 +122,26 @@ def test_parallel_learning_example_conf(tmp_path, monkeypatch):
     rc = main(["config=train.conf", "num_iterations=2",
                f"output_model={model}", "verbosity=-1"])
     assert rc == 0 and model.exists()
+
+
+@pytest.mark.parametrize("example", [
+    "regression", "binary_classification", "multiclass_classification",
+    "lambdarank", "xendcg"])
+def test_cli_runs_every_reference_example(example, tmp_path, monkeypatch):
+    """Every reference example's own train.conf must train AND its
+    predict.conf must predict through our CLI, unmodified except the
+    output paths (the switch-over contract: a reference user's configs
+    keep working).  Mirrors tests/python_package_test/test_consistency.py
+    driving examples/*/train.conf."""
+    ex = f"{EXAMPLES}/{example}"
+    model = tmp_path / "model.txt"
+    monkeypatch.chdir(ex)  # configs use relative data paths
+    rc = main([f"config={ex}/train.conf", "num_trees=5",
+               f"output_model={model}", "verbosity=-1"])
+    assert rc == 0 and model.exists()
+    pred_out = tmp_path / "pred.txt"
+    rc = main([f"config={ex}/predict.conf", f"input_model={model}",
+               f"output_result={pred_out}"])
+    assert rc == 0
+    preds = np.loadtxt(pred_out)
+    assert np.isfinite(preds).all() and len(preds) > 0
