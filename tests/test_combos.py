@@ -62,6 +62,18 @@ COMBOS = [
     ("l1_max_delta", {"lambda_l1": 0.5, "max_delta_step": 0.5}),
     ("quantized_monotone", {"use_quantized_grad": True,
                             "monotone_constraints": [1, 0, 0, 0, 0]}),
+    ("efb_categorical", {"enable_bundle": True,
+                         "categorical_feature": [4]}, True),
+    ("dart_linear", {"boosting": "dart", "linear_tree": True}),
+    ("goss_intermediate_monotone", {
+        "data_sample_strategy": "goss",
+        "monotone_constraints": [1, 0, 0, 0, 0],
+        "monotone_constraints_method": "intermediate"}),
+    ("rf_categorical", {"boosting": "rf", "bagging_freq": 1,
+                        "bagging_fraction": 0.7,
+                        "categorical_feature": [4]}, True),
+    ("quantized_extra_trees", {"use_quantized_grad": True,
+                               "extra_trees": True}),
 ]
 
 
