@@ -55,6 +55,14 @@ def _goss_sample(grad, hess, pad_mask, key, top_k, other_k):
     return keep, grad * scale[None, :], hess * scale[None, :]
 
 
+def _mesh_size(config, ndev: int) -> int:
+    """Device-mesh size policy shared by the EFB gate and
+    _make_training_mesh: num_machines caps the local device count
+    (ref: config.h num_machines; application.cpp:100 machine setup)."""
+    want = config.num_machines if config.num_machines > 1 else ndev
+    return min(want, ndev)
+
+
 def _pad_rows(arr: np.ndarray, n_pad: int, axis: int = -1, fill=0):
     n = arr.shape[axis]
     if n == n_pad:
@@ -143,9 +151,7 @@ class GBDT:
         self._voting = tl == "voting"
         if tl == "serial":
             return None
-        ndev = len(jax.devices())
-        want = config.num_machines if config.num_machines > 1 else ndev
-        n_mesh = min(want, ndev)
+        n_mesh = _mesh_size(config, len(jax.devices()))
         if tl == "feature":
             # GSPMD needs the sharded axis size divisible by the mesh: use
             # the largest divisor of the device column count (the reference
@@ -208,9 +214,12 @@ class GBDT:
         # model IO) keep per-feature bins.
         self.bundle_plan = None
         # the PV-Tree vote is per-feature, so EFB is skipped only when
-        # voting will actually engage (a >1-device mesh exists)
+        # voting will actually engage: a >1-device mesh exists AND the
+        # num_machines cap doesn't reduce the mesh to a single device
+        # (otherwise _make_training_mesh returns None and serial training
+        # would silently lose bundling)
         voting_engages = (config.tree_learner == "voting"
-                          and len(jax.devices()) > 1)
+                          and _mesh_size(config, len(jax.devices())) > 1)
         if (config.enable_bundle and train_data.num_features > 1
                 and not voting_engages):
             from ..io.bundle import build_bundled, plan_bundles
@@ -1400,7 +1409,6 @@ class GBDT:
         """Refit the existing tree structures' leaf values to new data
         (ref: gbdt.cpp:252 RefitTree; serial_tree_learner.cpp:241
         FitByExistingTree: new_leaf = decay*old + (1-decay)*output*shrink)."""
-        self._model_mutations = getattr(self, "_model_mutations", 0) + 1
         self._sync_model()
         import jax.numpy as jnp_
         from ..io.dataset import Metadata
@@ -1408,21 +1416,34 @@ class GBDT:
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         K = self.num_tree_per_iteration
-        cfg = self.config
-        decay = cfg.refit_decay_rate
         leaf_preds = self.predict_leaf_index(X)        # [n, num_trees]
         md = Metadata(n)
         md.set_label(np.asarray(label, np.float64))
         if weight is not None:
             md.set_weight(weight)
-        obj = self.objective or create_objective(cfg)
+        obj = self.objective or create_objective(self.config)
         obj.init(md, n)
         lab = jnp_.asarray(np.asarray(obj.label, np.float32))
         w = (None if md.weight is None
              else jnp_.asarray(np.asarray(md.weight, np.float32)))
         score = np.zeros((K, n), np.float64)
+        try:
+            self._refit_trees(obj, lab, w, score, leaf_preds)
+        finally:
+            # the in-place leaf mutations invalidate the packed-predictor
+            # cache; bump AFTER them (not before predict_leaf_index above,
+            # which would repopulate the cache under the new key) and even
+            # when a later iteration raises mid-mutation
+            self._model_mutations = getattr(self, "_model_mutations", 0) + 1
+
+    def _refit_trees(self, obj, lab, w, score, leaf_preds):
+        import jax.numpy as jnp_
+        cfg = self.config
+        K = self.num_tree_per_iteration
         num_iters = len(self.models_) // K
+        decay = cfg.refit_decay_rate
         l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        n = score.shape[1]
         for it in range(num_iters):
             sc = jnp_.asarray(score.astype(np.float32))
             g, h = obj.get_gradients(sc if K > 1 else sc[0], lab, w)

@@ -88,6 +88,13 @@ def parse_file(path: str, has_header: bool = False,
             for t in toks[1:]:
                 k, v = t.split(":", 1)
                 ki = int(k)
+                if ki < 0:
+                    # match the native parser's rejection — same exception
+                    # type and message shape as parse_libsvm_native
+                    # (native/parser.c lgbt_parse_libsvm): a negative index
+                    # must not train silently via negative indexing
+                    raise ValueError(
+                        f"malformed libsvm pair on data line {i + 1}")
                 row.append((ki, float(v)))
                 max_idx = max(max_idx, ki)
             rows.append(row)

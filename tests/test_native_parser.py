@@ -79,3 +79,28 @@ def test_libsvm_bad_pair_raises():
         parse_libsvm_native(b"1 0x10:1\n")
     with pytest.raises(ValueError, match="line 2"):
         parse_libsvm_native(b"1 0:1\n0 1:2q\n")
+
+
+def test_libsvm_negative_index_rejected_both_paths(tmp_path):
+    """Native and Python-fallback LibSVM parsers must reject a negative
+    feature index identically (the fallback used to train silently via
+    Python negative indexing)."""
+    import pytest
+
+    import lightgbm_tpu.io.parser as P
+    import lightgbm_tpu.native as N
+
+    f = tmp_path / "bad.svm"
+    f.write_text("1 0:1.5 -2:3.0\n0 1:2.0\n")
+    # native path (when a compiler exists) and forced Python fallback must
+    # both raise ValueError with the native parser's message shape
+    if N.parser_lib() is not None:
+        with pytest.raises(ValueError, match="malformed libsvm pair"):
+            P.parse_file(str(f))
+    orig = N.parse_libsvm_native
+    N.parse_libsvm_native = lambda *a, **k: None
+    try:
+        with pytest.raises(ValueError, match="malformed libsvm pair"):
+            P.parse_file(str(f))
+    finally:
+        N.parse_libsvm_native = orig

@@ -14,13 +14,18 @@ pytestmark = pytest.mark.skipif(N.predictor_lib() is None,
 
 
 def _predict_both(booster, X):
+    """Native-path prediction vs the pure-Python traversal.  The native
+    path is the cached PackedPredictor behind gbdt._packed_for, gated on
+    native.predictor_lib(); stubbing THAT to None forces the Python
+    traversal (stubbing the unused predict_batch_native would compare the
+    native path against itself)."""
     p_native = booster.predict(X)
-    orig = N.predict_batch_native
-    N.predict_batch_native = lambda *a, **k: None
+    orig = N.predictor_lib
+    N.predictor_lib = lambda: None
     try:
         p_py = booster.predict(X)
     finally:
-        N.predict_batch_native = orig
+        N.predictor_lib = orig
     return p_native, p_py
 
 
@@ -70,12 +75,12 @@ def test_native_predict_start_num_iteration():
     for kw in ({"start_iteration": 2, "num_iteration": 3},
                {"num_iteration": 5},):
         p_n = b.predict(X, **kw)
-        orig = N.predict_batch_native
-        N.predict_batch_native = lambda *a, **k: None
+        orig = N.predictor_lib
+        N.predictor_lib = lambda: None
         try:
             p_p = b.predict(X, **kw)
         finally:
-            N.predict_batch_native = orig
+            N.predictor_lib = orig
         np.testing.assert_array_equal(p_n, p_p)
 
 
@@ -135,3 +140,25 @@ def test_native_pred_leaf_matches_python():
     b._gbdt._sync_model()
     leaves_p = np.stack([t.get_leaf_index(X) for t in b._gbdt.models_], 1)
     np.testing.assert_array_equal(leaves_n, leaves_p)
+
+
+def test_refit_invalidates_packed_cache():
+    """refit() mutates leaf values in place AFTER predict_leaf_index has
+    (re)populated the packed-predictor cache; native predictions must
+    reflect the refitted values (regression test for the mutation-counter
+    ordering bug)."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(600, 4)
+    y = (X[:, 0] > 0.5).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    _ = b.predict(X)                      # populate the packed cache
+    b.refit(X, 1.0 - y)                   # inverted labels
+    p_n, p_p = _predict_both(b, X)
+    np.testing.assert_array_equal(p_n, p_p)
+    # refitted native predictions must differ from the pre-refit model
+    b2 = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1, "min_data_in_leaf": 5},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    assert not np.allclose(b.predict(X), b2.predict(X))

@@ -1,0 +1,116 @@
+"""Can the one-hot build + dot go int8 end-to-end without an int32 detour?
+Variants timed at bench shapes (1M rows, 32 padded features, 256 bins)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+N = 1 << 20
+Fp = 32
+B = 256
+REPS = 10
+
+rng = np.random.RandomState(0)
+binned_fm = jnp.asarray(rng.randint(0, B, size=(Fp, N), dtype=np.uint8))
+gh_bf = jnp.asarray(rng.randn(N, 128).astype(np.float32))
+gh_i8 = jnp.asarray(rng.randint(-63, 64, size=(N, 128), dtype=np.int8))
+
+
+def timeit(name, fn):
+    @jax.jit
+    def loop():
+        def step(c, _):
+            r = fn()
+            return c + jnp.float32(jnp.sum(r[..., 0])), None
+        out, _ = jax.lax.scan(step, jnp.float32(0), None, length=REPS)
+        return out
+    try:
+        loop().block_until_ready()
+    except Exception as e:
+        print(f"{name:50s} FAILED: {str(e)[:120]}", flush=True)
+        return
+    t0 = time.time()
+    loop().block_until_ready()
+    dt = (time.time() - t0) / REPS
+    print(f"{name:50s} {dt*1e3:8.2f} ms", flush=True)
+
+
+def build_kernel(oh_dtype, gh_dtype, acc_dtype, via_i32=False, do_dot=True,
+                 dims3=False):
+    def kernel(rows_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        Fg = rows_ref.shape[0]
+        rows = rows_ref[...].astype(jnp.int32)
+        ghv = gh_ref[...].astype(gh_dtype)
+        Rt = rows.shape[1]
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, B, Rt), 1)
+        eq = rows[:, None, :] == biota
+        if via_i32:
+            oh = jnp.where(eq, 1, 0).astype(oh_dtype)
+        else:
+            oh = eq.astype(oh_dtype)
+        if do_dot:
+            if dims3:
+                acc = jax.lax.dot_general(
+                    oh, ghv, (((2,), (0,)), ((), ())),
+                    preferred_element_type=acc_dtype)
+                out_ref[...] += acc
+            else:
+                acc = jax.lax.dot_general(
+                    oh.reshape(Fg * B, Rt), ghv, (((1,), (0,)), ((), ())),
+                    preferred_element_type=acc_dtype)
+                out_ref[...] += acc.reshape(Fg, B, ghv.shape[-1])
+        else:
+            out_ref[...] += jnp.sum(oh, axis=2).astype(acc_dtype)[:, :, None]
+    return kernel
+
+
+def run(name, oh_dtype, gh, gh_dtype, acc_dtype, lanes=128, via_i32=False,
+        do_dot=True, row_tile=512, dims3=False, Fg=8):
+    ghl = gh[:, :lanes]
+
+    def fn():
+        out_lanes = lanes if do_dot else 1
+        return pl.pallas_call(
+            build_kernel(oh_dtype, gh_dtype, acc_dtype, via_i32, do_dot,
+                         dims3),
+            grid=(Fp // Fg, N // row_tile),
+            in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
+                      pl.BlockSpec((row_tile, lanes), lambda g, i: (i, 0))],
+            out_specs=pl.BlockSpec((Fg, B, out_lanes), lambda g, i: (g, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((Fp, B, out_lanes), acc_dtype),
+        )(binned_fm, ghl).astype(jnp.float32)
+    timeit(name, fn)
+
+
+run("build i8 direct, no dot", jnp.int8, gh_i8, jnp.int8, jnp.int32,
+    do_dot=False)
+run("build i8 via i32 where, no dot", jnp.int8, gh_i8, jnp.int8, jnp.int32,
+    via_i32=True, do_dot=False)
+run("build bf16 direct, no dot", jnp.bfloat16, gh_bf, jnp.bfloat16,
+    jnp.float32, do_dot=False)
+run("i8 oh x i8 gh -> i32, 128 lanes", jnp.int8, gh_i8, jnp.int8, jnp.int32)
+run("i8 oh x i8 gh -> i32, 256 lanes", jnp.int8, gh_i8, jnp.int8, jnp.int32,
+    lanes=128)
+run("i8 oh x bf16 gh -> f32, 128 lanes", jnp.int8, gh_bf, jnp.bfloat16,
+    jnp.float32)
+run("bf16 oh x bf16 gh -> f32, 128 lanes (ref)", jnp.bfloat16, gh_bf,
+    jnp.bfloat16, jnp.float32)
+run("bf16 3-D dot (no reshape), 128 lanes", jnp.bfloat16, gh_bf,
+    jnp.bfloat16, jnp.float32, dims3=True)
+run("bf16 Rt=256", jnp.bfloat16, gh_bf, jnp.bfloat16, jnp.float32,
+    row_tile=256)
+run("bf16 Rt=1024", jnp.bfloat16, gh_bf, jnp.bfloat16, jnp.float32,
+    row_tile=1024)
+run("i8 Rt=1024 i8 gh", jnp.int8, gh_i8, jnp.int8, jnp.int32, row_tile=1024)
+run("i8 Rt=2048 i8 gh", jnp.int8, gh_i8, jnp.int8, jnp.int32, row_tile=2048)

@@ -1,0 +1,127 @@
+"""Split the per-wave cost into (one-hot build) vs (MXU dot) and measure
+the primitives a subtraction/compaction redesign needs (gather, scatter,
+cumsum) at bench shapes.  End-to-end scan-timed on the real chip."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+N = 1 << 20
+F = 28
+Fp = 32
+B = 256
+REPS = 10
+
+rng = np.random.RandomState(0)
+binned_fm = jnp.asarray(rng.randint(0, B, size=(Fp, N), dtype=np.uint8))
+binned_rm = jnp.asarray(rng.randint(0, B, size=(N, Fp), dtype=np.uint8))
+gh3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+half_idx = jnp.asarray(np.sort(rng.permutation(N)[: N // 2]).astype(np.int32))
+mask = jnp.asarray((rng.rand(N) < 0.5).astype(np.float32))
+
+
+def timeit(name, fn):
+    @jax.jit
+    def loop():
+        def step(c, _):
+            r = fn()
+            return c + jnp.float32(jnp.sum(r[0][..., 0]) if isinstance(r, tuple)
+                                   else jnp.sum(r[..., 0])), None
+        out, _ = jax.lax.scan(step, jnp.float32(0), None, length=REPS)
+        return out
+
+    loop().block_until_ready()
+    t0 = time.time()
+    loop().block_until_ready()
+    dt = (time.time() - t0) / REPS
+    print(f"{name:45s} {dt*1e3:8.2f} ms", flush=True)
+
+
+# --- A: one-hot build only (reduce, no dot) -------------------------------
+def _oh_only_kernel(Fg, Bg):
+    def kernel(rows_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        rows = rows_ref[...].astype(jnp.int32)
+        Rt = rows.shape[1]
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
+        oh = (rows[:, None, :] == biota).astype(jnp.float32)
+        out_ref[...] += jnp.sum(oh, axis=2)
+    return kernel
+
+
+def oh_only(row_tile=512, Fg=8):
+    out = pl.pallas_call(
+        _oh_only_kernel(Fg, B),
+        grid=(Fp // Fg, N // row_tile),
+        in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i))],
+        out_specs=pl.BlockSpec((Fg, B), lambda g, i: (g, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp, B), jnp.float32),
+    )(binned_fm)
+    return out
+
+
+# --- B: one-hot + 1-lane-tile dot ----------------------------------------
+def _oh_dot_kernel(Fg, Bg, NLanes):
+    def kernel(rows_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        rows = rows_ref[...].astype(jnp.int32)
+        ghv = gh_ref[...].astype(jnp.bfloat16)  # [Rt, NLanes]
+        Rt = rows.shape[1]
+        biota = jax.lax.broadcasted_iota(jnp.int32, (Fg, Bg, Rt), 1)
+        oh = (rows[:, None, :] == biota).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            oh.reshape(Fg * Bg, Rt), ghv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[...] += acc.reshape(Fg, Bg, NLanes)
+    return kernel
+
+
+def oh_dot(NLanes=128, row_tile=512, Fg=8):
+    ghn = jnp.broadcast_to(gh3[:, :1], (N, NLanes))
+    out = pl.pallas_call(
+        _oh_dot_kernel(Fg, B, NLanes),
+        grid=(Fp // Fg, N // row_tile),
+        in_specs=[pl.BlockSpec((Fg, row_tile), lambda g, i: (g, i)),
+                  pl.BlockSpec((row_tile, NLanes), lambda g, i: (i, 0))],
+        out_specs=pl.BlockSpec((Fg, B, NLanes), lambda g, i: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp, B, NLanes), jnp.float32),
+    )(binned_fm, ghn)
+    return out
+
+
+timeit("A one-hot only (Fg=8, Rt=512)", oh_only)
+timeit("A one-hot only (Fg=8, Rt=1024)", functools.partial(oh_only, 1024))
+timeit("A one-hot only (Fg=16, Rt=512)", functools.partial(oh_only, 512, 16))
+timeit("B one-hot + dot 128 lanes", oh_dot)
+timeit("B one-hot + dot 256 lanes", functools.partial(oh_dot, 256))
+timeit("B one-hot + dot 128 lanes Rt=1024",
+       functools.partial(oh_dot, 128, 1024))
+
+# --- primitives -----------------------------------------------------------
+timeit("gather rows rm [N/2, 32]u8",
+       lambda: jnp.take(binned_rm, half_idx, axis=0).astype(jnp.float32))
+timeit("gather cols fm [32, N/2]u8",
+       lambda: jnp.take(binned_fm, half_idx, axis=1).astype(jnp.float32)[:1].T)
+timeit("gather gh rows [N/2, 3]f32",
+       lambda: jnp.take(gh3, half_idx, axis=0))
+timeit("cumsum mask [N]f32", lambda: jnp.cumsum(mask)[:, None])
+timeit("scatter-compact idx (N/2 unique)",
+       lambda: jnp.zeros(N // 2, jnp.int32).at[
+           jnp.clip(jnp.cumsum(mask).astype(jnp.int32) - 1, 0, N // 2 - 1)
+       ].set(jnp.arange(N, dtype=jnp.int32), mode="drop",
+             unique_indices=False)[:, None].astype(jnp.float32))
+timeit("full permute rows rm [N, 32]u8",
+       lambda: jnp.take(binned_rm, perm, axis=0).astype(jnp.float32))
