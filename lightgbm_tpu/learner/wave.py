@@ -105,20 +105,22 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     use_int8 = (use_pallas and params.quant_bins > 0
                 and quant_scales is not None)
 
-    def hists_of(leaf_id, num_slots):
-        """Group-space histograms; converted per slot at the scan."""
+    def hists_of(kslot, ghm, num_slots):
+        """Group-space histograms for the COMPUTED (compact) slots only;
+        rows outside computed leaves carry zeroed gh channels.  The full
+        per-leaf set is completed by sibling subtraction at the cache."""
         if use_pallas:
             if use_int8:
                 # quantized grid grads -> exact int32 accumulation through
                 # the MXU int8 path (ref: dense_bin.hpp:174
                 # ConstructHistogramIntInner)
                 return build_histogram_wave(
-                    binned, leaf_id, gh, max_bin=hist_B,
+                    binned, kslot, ghm, max_bin=hist_B,
                     num_slots=num_slots, quant_bins=params.quant_bins,
                     quant_scales=quant_scales)
-            return build_histogram_wave(binned, leaf_id, gh,
+            return build_histogram_wave(binned, kslot, ghm,
                                         max_bin=hist_B, num_slots=num_slots)
-        return _hist_wave_xla(binned, leaf_id, gh, max_bin=hist_B,
+        return _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
                               num_slots=num_slots)
 
     if sp.extra_trees:
@@ -237,16 +239,99 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     leaf_cmin0 = jnp.full(cm_n, -jnp.inf, f32)
     leaf_cmax0 = jnp.full(cm_n, jnp.inf, f32)
 
-    def wave_body(state, NLp):
-        """One wave with a static slot bound NLp >= current num_leaves."""
-        (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
-         leaf_cmin, leaf_cmax, used_vec, _) = state
+    # per-leaf histogram cache (flat [Lp, F'*B'*2] for MXU-friendly
+    # selection matmuls) + exact count cache, carried across waves (the
+    # HistogramPool analogue, feature_histogram.hpp:1367); completed by
+    # sibling subtraction
+    Fh = binned.shape[0]
+    Dh = Fh * hist_B * 2
+    cache_h0 = jnp.zeros((Lp, Dh), f32)
+    cache_c0 = jnp.zeros(Lp, f32)
+    # pending-split tables from the previous wave (Lp-indexed by the slot
+    # that split): new right slot, pair rank (= compact kernel slot of the
+    # smaller child), smaller-side flag
+    pend_sel0 = jnp.zeros(Lp, bool)
+    pend_new0 = jnp.zeros(Lp, i32)
+    pend_rank0 = jnp.zeros(Lp, i32)
+    pend_sl0 = jnp.zeros(Lp, bool)
+
+    def wave_hists(kslot, cache_h, cache_c,
+                   pend_sel, pend_new, pend_rank, pend_sl, Kb, first):
+        """Update the per-leaf histogram cache for the leaves created by
+        the previous wave: ONE fused kernel pass computes the SMALLER
+        child of each pending split (compact slot = pair rank), the larger
+        sibling is parent − smaller (ref: serial_tree_learner.cpp:334
+        smaller/larger leaf split, feature_histogram.hpp Subtract) — so
+        late waves stream half the rows' worth of MXU lanes instead of
+        every leaf's.  kslot [n] is the compact computed slot per row,
+        assigned during the PREVIOUS wave's recolor (rows outside a
+        computed leaf carry the out-of-range sentinel Lp, which matches no
+        slot one-hot bucket — no per-row gather or gh masking needed
+        here)."""
+        H, cnt = hists_of(kslot, gh, Kb)               # [Kb, F', B', 2]
+        cnt = cnt.astype(f32)
+        if first:
+            # root wave: kslot is all zeros; one computed slot
+            cache_h = cache_h.at[0].set(H.reshape(Kb, Dh)[0])
+            cache_c = cache_c.at[0].set(cnt[0])
+            return cache_h, cache_c
+        # rank -> (parent slot, right slot, smaller-left) tables
+        rdrop = jnp.where(pend_sel, pend_rank, Kb)
+        slots = jnp.arange(Lp, dtype=i32)
+        p_of = jnp.zeros(Kb, i32).at[rdrop].set(slots, mode="drop")
+        q_of = jnp.zeros(Kb, i32).at[rdrop].set(pend_new, mode="drop")
+        sl_of = jnp.zeros(Kb, bool).at[rdrop].set(pend_sl, mode="drop")
+        valid = jnp.zeros(Kb, bool).at[rdrop].set(True, mode="drop")
+        # gather (parent) and scatter (children) as ONE-HOT MXU MATMULS:
+        # XLA's slice gather/scatter runs ~1GB/s on TPU, while a [Kb, Lp]
+        # selection matmul against the flat [Lp, D] cache is microseconds
+        # on the MXU and EXACT — one-hot rows have at most one nonzero, so
+        # there is no accumulation and HIGHEST precision reproduces the
+        # fp32 operand bit-for-bit
+        HI = jax.lax.Precision.HIGHEST
+        Hf = H.reshape(Kb, Dh)
+        lr = jnp.arange(Lp, dtype=i32)
+        pv = jnp.where(valid, p_of, Lp)
+        qv = jnp.where(valid, q_of, Lp)
+        P_par = (pv[:, None] == lr[None, :]).astype(f32)    # [Kb, Lp]
+        parent_h = jax.lax.dot_general(P_par, cache_h,
+                                       (((1,), (0,)), ((), ())),
+                                       precision=HI)        # [Kb, Dh]
+        other_h = parent_h - Hf
+        slb = sl_of[:, None]
+        W = jnp.concatenate([(lr[:, None] == pv[None, :]),
+                             (lr[:, None] == qv[None, :])],
+                            axis=1).astype(f32)             # [Lp, 2Kb]
+        child_h = jnp.concatenate([jnp.where(slb, Hf, other_h),
+                                   jnp.where(slb, other_h, Hf)], axis=0)
+        upd = jax.lax.dot_general(W, child_h, (((1,), (0,)), ((), ())),
+                                  precision=HI)             # [Lp, Dh]
+        keep = 1.0 - jnp.clip(jnp.sum(W, axis=1), 0.0, 1.0)
+        cache_h = cache_h * keep[:, None] + upd
+        parent_c = jnp.sum(P_par * cache_c[None, :], axis=1)
+        other_c = parent_c - cnt
+        child_c = jnp.concatenate([jnp.where(sl_of, cnt, other_c),
+                                   jnp.where(sl_of, other_c, cnt)])
+        cache_c = cache_c * keep + jnp.sum(W * child_c[None, :], axis=1)
+        return cache_h, cache_c
+
+    def wave_body(state, NLp, Kb, first=False):
+        """One wave with a static slot bound NLp >= current num_leaves and
+        a static computed-slot bound Kb >= splits of the previous wave."""
+        (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
+         leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
+         pend_sel, pend_new, pend_rank, pend_sl, _) = state
         NL = tree.num_leaves
 
-        # 1. all leaves' histograms + exact per-slot counts in one pass
-        #    (DataPartition cnt_leaf_data)
-        hists, fcounts = hists_of(leaf_id, NLp)       # [NLp, F, B, 2], [NLp]
-        counts = jnp.round(fcounts).astype(i32)
+        # 1. refresh the per-leaf cache for last wave's children (smaller
+        #    child computed, larger by subtraction), then scan ALL leaves
+        #    from the cache (DataPartition cnt_leaf_data exactness rides
+        #    the count cache)
+        cache_h, cache_c = wave_hists(kslot, cache_h, cache_c, pend_sel,
+                                      pend_new, pend_rank, pend_sl, Kb,
+                                      first)
+        hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
+        counts = jnp.round(cache_c[:NLp]).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
         rb = (_rand_bins(tree.num_leaves)[:NLp] if sp.extra_trees else None)
         rcu = (_rand_cat_us(tree.num_leaves)[:NLp]
@@ -363,23 +448,45 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # is [NLp, 8] numerical-only; the categorical columns (is_cat +
         # bitset words) are appended only when the dataset has categorical
         # features, keeping the hot gather narrow in the common case.
+        # smaller side per split pair, chosen by the SCAN's (approximate,
+        # RoundInt-parity) counts — either choice yields the same exact
+        # pair of histograms by subtraction
+        small_left = best.left_count <= best.right_count
         cols = [split_sel.astype(i32), best.feature, best.threshold,
                 best.default_left.astype(i32), newleaf_of,
                 jnp.take(meta.missing_type, best.feature),
                 jnp.take(meta.default_bin, best.feature),
-                jnp.take(meta.num_bin, best.feature)]
+                jnp.take(meta.num_bin, best.feature),
+                rank_of, small_left.astype(i32)]
         if params.has_bundles:
             cols += [jnp.take(meta.group, best.feature),
                      jnp.take(meta.offset, best.feature),
                      jnp.take(meta.zero_bin, best.feature)]
         n_base = len(cols)
         if sp.has_categorical:
-            packed = jnp.concatenate(
-                [jnp.stack(cols + [best.is_cat.astype(i32)], axis=1),
-                 best.cat_bitset], axis=1)
-        else:
-            packed = jnp.stack(cols, axis=1)
-        prow = jnp.take(packed, leaf_id, axis=0)
+            # cat bitset words carry full 32-bit patterns: pre-split into
+            # positive 16-bit halves so the byte decomposition below stays
+            # exact
+            bs = best.cat_bitset
+            cols = (cols + [best.is_cat.astype(i32)]
+                    + [bs[:, w] & 0xFFFF for w in range(W)]
+                    + [(bs[:, w] >> 16) & 0xFFFF for w in range(W)])
+        packed = jnp.stack(cols, axis=1)                # [NLp, nc] < 2^24
+        # per-row table lookup as a one-hot MXU matmul instead of an XLA
+        # row gather (~1GB/s on TPU): values are decomposed into bytes so
+        # the bf16 operands are exact, and each output sums exactly one
+        # nonzero product — bit-exact reconstruction
+        nc = packed.shape[1]
+        tab = jnp.concatenate([packed & 255, (packed >> 8) & 255,
+                               (packed >> 16) & 255], axis=1)
+        oh_rows = (leaf_id[:, None] ==
+                   jnp.arange(NLp, dtype=i32)[None, :]).astype(jnp.bfloat16)
+        got = jax.lax.dot_general(
+            oh_rows, tab.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [n, 3*nc]
+        prow = (got[:, :nc].astype(i32)
+                + (got[:, nc:2 * nc].astype(i32) << 8)
+                + (got[:, 2 * nc:].astype(i32) << 16))
         sel_r = prow[:, 0] > 0
         feat_r = prow[:, 1]
         thr_r = prow[:, 2]
@@ -388,10 +495,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         mt_r = prow[:, 5]
         db_r = prow[:, 6]
         nb_r = prow[:, 7]
+        rank_r = prow[:, 8]
+        sleft_r = prow[:, 9] > 0
         if params.has_bundles:
-            grp_r = prow[:, 8]
-            off_r = prow[:, 9]
-            zb_r = prow[:, 10]
+            grp_r = prow[:, 10]
+            off_r = prow[:, 11]
+            zb_r = prow[:, 12]
             col_r = grp_r
         else:
             col_r = feat_r
@@ -408,12 +517,20 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         go_left = jnp.where(is_missing, dleft_r, fbin <= thr_r)
         if sp.has_categorical:
             isc_r = prow[:, n_base] > 0
-            word_r = jnp.take_along_axis(
-                prow[:, n_base + 1:],
-                jnp.clip(fbin // 32, 0, W - 1)[:, None], 1)[:, 0]
+            widx = jnp.clip(fbin // 32, 0, W - 1)[:, None]
+            w_lo = jnp.take_along_axis(
+                prow[:, n_base + 1:n_base + 1 + W], widx, 1)[:, 0]
+            w_hi = jnp.take_along_axis(
+                prow[:, n_base + 1 + W:n_base + 1 + 2 * W], widx, 1)[:, 0]
+            word_r = w_lo | (w_hi << 16)
             cat_left = ((word_r >> (fbin % 32)) & 1) > 0
             go_left = jnp.where(isc_r, cat_left, go_left)
         leaf_id = jnp.where(sel_r & ~go_left, new_r, leaf_id)
+        # the NEXT wave's computed-slot assignment rides this recolor pass
+        # (no extra per-row gather): a row is in the computed set iff it
+        # landed in its pair's smaller child; everyone else gets the
+        # out-of-range sentinel Lp, which matches no slot one-hot bucket
+        kslot = jnp.where(sel_r & (go_left == sleft_r), rank_r, Lp)
 
         if sp.has_cegb:
             # all of this wave's winning features become used (coupled
@@ -423,33 +540,55 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             used_vec = used_vec.at[jnp.where(split_sel, best.feature,
                                              num_features)].set(
                 True, mode="drop")
+        # pending tables for the next wave's cache completion
+        lpz = jnp.zeros(Lp, i32)
+        pend_sel = jnp.zeros(Lp, bool).at[:NLp].set(split_sel)
+        pend_new = lpz.at[:NLp].set(newleaf_of)
+        pend_rank = lpz.at[:NLp].set(rank_of)
+        pend_sl = jnp.zeros(Lp, bool).at[:NLp].set(small_left)
         cont = (n_split > 0) & (tree.num_leaves < L)
-        return (tree, leaf_id, leaf_sum_g, leaf_sum_h, leaf_out,
-                leaf_cmin, leaf_cmax, used_vec, cont)
+        return (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
+                leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
+                pend_sel, pend_new, pend_rank, pend_sl, cont)
 
     if cegb_used is None:
         cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
-    state = (tree, jnp.zeros(n, i32), leaf_sum_g0, leaf_sum_h0, leaf_out0,
-             leaf_cmin0, leaf_cmax0, cegb_used, jnp.asarray(L > 1))
+    state = (tree, jnp.zeros(n, i32), jnp.zeros(n, i32), leaf_sum_g0,
+             leaf_sum_h0, leaf_out0, leaf_cmin0, leaf_cmax0, cegb_used,
+             cache_h0, cache_c0, pend_sel0, pend_new0, pend_rank0, pend_sl0,
+             jnp.asarray(L > 1))
     num_waves = max(1, math.ceil(math.log2(L))) if L > 1 else 0
     for k in range(num_waves):
         NLp = wave_slot_pad(min(1 << k, L))
+        # computed slots this wave = splits of the previous wave, bounded
+        # by the previous wave's leaf count (root wave computes 1 slot)
+        Kb = wave_slot_pad(min(1 << max(k - 1, 0), L))
         state = jax.lax.cond(state[-1],
-                             functools.partial(wave_body, NLp=NLp),
+                             functools.partial(wave_body, NLp=NLp, Kb=Kb,
+                                               first=(k == 0)),
                              lambda s: s, state)
     if num_waves > 0:
         # growth slower than doubling (chain-shaped gain landscapes) needs
         # more rounds than the unrolled ladder: keep waving at the full
-        # slot bound until no leaf splits or the budget is exhausted
+        # slot bound until no leaf splits or the budget is exhausted.
+        # Splits per wave <= min(NL, L - NL) <= L // 2.
         state = jax.lax.while_loop(
             lambda s: s[-1],
-            functools.partial(wave_body, NLp=wave_slot_pad(L)), state)
+            functools.partial(wave_body, NLp=wave_slot_pad(L),
+                              Kb=wave_slot_pad(max(L // 2, 1))), state)
 
     tree, leaf_id = state[0], state[1]
     if num_waves > 0:
-        # exact final counts from the final partition (one scatter-add;
-        # ref: DataPartition cnt_leaf_data)
-        exact = (jnp.zeros(Lp, f32).at[leaf_id].add(row_mask)).astype(i32)
+        # exact final counts from the final partition (ref: DataPartition
+        # cnt_leaf_data).  A one-hot MXU matmul instead of a 1M-element
+        # scatter-add: the one-hot and 0/1 mask are exact in bf16 and the
+        # fp32 accumulator holds integer sums < 2^24 exactly.
+        oh = (leaf_id[:, None] ==
+              jnp.arange(Lp, dtype=i32)[None, :]).astype(jnp.bfloat16)
+        exact = jax.lax.dot_general(
+            row_mask.astype(jnp.bfloat16)[None, :], oh,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)[0].astype(i32)
         tree = tree._replace(leaf_count=exact)
     if Lp != L:  # back to the caller-visible [L] leaf layout
         tree = tree._replace(

@@ -1,0 +1,54 @@
+"""Decompose the tree-growth iteration cost at bench shapes: time
+grow_tree_wave alone for several num_leaves, on-device data."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.learner import FeatureMeta, GrowParams, grow_tree_wave
+from lightgbm_tpu.ops.split import SplitParams
+
+N = 1 << 20
+F = 28
+B = 255
+
+key = jax.random.PRNGKey(0)
+binned = jax.jit(lambda: jax.random.randint(
+    key, (F, N), 0, B, jnp.int32).astype(jnp.uint8))()
+grad = jax.jit(lambda: jax.random.normal(key, (N,), jnp.float32))()
+hess = jax.jit(lambda: jax.random.uniform(
+    key, (N,), jnp.float32, 0.05, 0.25))()
+row_mask = jnp.ones(N, jnp.float32)
+col_mask = jnp.ones(F, bool)
+meta = FeatureMeta(
+    num_bin=jnp.full(F, B, jnp.int32),
+    missing_type=jnp.zeros(F, jnp.int32),
+    default_bin=jnp.zeros(F, jnp.int32),
+    penalty=jnp.ones(F, jnp.float32))
+
+
+def timed(L, reps=5):
+    params = GrowParams(num_leaves=L, max_bin=B, hist_method="pallas",
+                        split=SplitParams(min_data_in_leaf=20))
+
+    def run():
+        t, lid = grow_tree_wave(binned, grad, hess, row_mask, col_mask,
+                                meta, params)
+        return t.leaf_value, lid
+
+    lv, lid = run()
+    lv.block_until_ready()
+    t0 = time.time()
+    for _ in range(reps):
+        lv, lid = run()
+    lv.block_until_ready()
+    dt = (time.time() - t0) / reps
+    print(f"L={L:4d}  {dt*1e3:8.1f} ms/tree", flush=True)
+
+
+for L in (2, 8, 32, 64, 128, 255):
+    timed(L)

@@ -100,8 +100,8 @@ run("build i8 via i32 where, no dot", jnp.int8, gh_i8, jnp.int8, jnp.int32,
 run("build bf16 direct, no dot", jnp.bfloat16, gh_bf, jnp.bfloat16,
     jnp.float32, do_dot=False)
 run("i8 oh x i8 gh -> i32, 128 lanes", jnp.int8, gh_i8, jnp.int8, jnp.int32)
-run("i8 oh x i8 gh -> i32, 256 lanes", jnp.int8, gh_i8, jnp.int8, jnp.int32,
-    lanes=128)
+run("i8 oh x i8 gh -> i32, 64 lanes", jnp.int8, gh_i8, jnp.int8, jnp.int32,
+    lanes=64)
 run("i8 oh x bf16 gh -> f32, 128 lanes", jnp.int8, gh_bf, jnp.bfloat16,
     jnp.float32)
 run("bf16 oh x bf16 gh -> f32, 128 lanes (ref)", jnp.bfloat16, gh_bf,
