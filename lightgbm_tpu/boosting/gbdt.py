@@ -371,6 +371,7 @@ class GBDT:
             feature_fraction_bynode=config.feature_fraction_bynode,
             bynode_seed=config.feature_fraction_seed + 1,
             monotone_intermediate=self._mono_intermediate,
+            wave_tail_halving=config.wave_tail_halving,
             # int8 MXU histogram path for quantized training (grid must
             # fit int8; hessian ints reach num_grad_quant_bins).  The
             # int32 accumulator must hold n * max_int for a root-level

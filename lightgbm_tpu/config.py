@@ -100,6 +100,10 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # leaf per round (vectorized, TPU-fast); "leafwise" is the strict
     # one-split-at-a-time reference-parity engine; "auto" picks wave on TPU.
     ("tpu_growth_strategy", "str", "auto", ("growth_strategy",)),
+    # wave engine tail shaping: once the leaf budget binds, spend at most
+    # half of it per wave (best-gain-first), allocating tail leaves closer
+    # to the leaf-wise order for a few extra cheap waves (PERF_NOTES.md)
+    ("wave_tail_halving", "bool", False, ()),
     ("num_threads", "int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
     ("device_type", "str", "tpu", ("device",)),
     ("seed", "int", 0, ("random_seed", "random_state")),

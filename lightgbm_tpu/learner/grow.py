@@ -112,6 +112,11 @@ class GrowParams(NamedTuple):
     # recursive GoUp/GoDownToFindLeavesToUpdate crawl.  Requires the
     # hist stack; incompatible with extra_trees / bynode sampling.
     monotone_intermediate: bool = False
+    # wave engine: once the leaf budget binds, spend at most half of it
+    # per wave (closer to the leaf-wise global-gain leaf allocation; a
+    # few extra cheap waves).  See PERF_NOTES.md for the measured
+    # wave-vs-leafwise AUC gap this addresses.
+    wave_tail_halving: bool = False
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",

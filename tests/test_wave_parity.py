@@ -1,0 +1,93 @@
+"""Wave-vs-leafwise engine parity: measured, bounded deviation.
+
+The wave engine batches splits level-wise (learner/wave.py), so when the
+num_leaves budget binds its trees allocate tail leaves more breadth-first
+than the reference's strict leaf-wise gain order (serial_tree_learner.cpp:219
+ArgMax leaf order).  Measured at bench scale (1M rows, 255 leaves, 13 iters
+on the v5e chip — PERF_NOTES.md):
+
+  engine                       sec/iter   held-out AUC
+  wave (default on TPU)        0.1445     0.72730
+  wave + wave_tail_halving     0.2667     0.72948
+  leafwise (parity engine)     5.04       0.73047
+  reference CLI (same data)    0.2223 (1-core CPU) 0.73087
+
+The leafwise engine matches the reference oracle's quality; the wave
+engine trades a bounded AUC delta for ~35x speed.  This test pins the
+bound at a CPU-tractable scale and asserts the tail-halving option sits
+between plain wave and leafwise in budget allocation behavior.
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+ROWS = 20_000
+LEAVES = 127
+ITERS = 8
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(ROWS, 10).astype(np.float32)
+    w = np.random.RandomState(7).randn(10)
+    logit = X @ w + 0.8 * X[:, 0] * X[:, 1] + np.sin(2 * X[:, 2])
+    y = (rng.rand(ROWS) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    return X, y
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def _train_auc(strategy, **extra):
+    X, y = _data(0)
+    Xte, yte = _data(1)
+    params = {"objective": "binary", "num_leaves": LEAVES,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "tpu_growth_strategy": strategy, **extra}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=ITERS)
+    return _auc(yte, b._gbdt.predict_raw(Xte)), b
+
+
+def test_wave_auc_within_bound_of_leafwise():
+    """Acceptance bound: the wave engine's held-out AUC is within 0.01 of
+    the strict leaf-wise engine at 127 leaves (measured delta at bench
+    scale is ~0.003; the bound leaves margin for small-sample noise)."""
+    auc_wave, b_wave = _train_auc("wave")
+    auc_leaf, b_leaf = _train_auc("leafwise")
+    assert abs(auc_leaf - auc_wave) < 0.01, (auc_leaf, auc_wave)
+    # both engines spend the full leaf budget on this gain landscape
+    mw = b_wave._gbdt.models_[0]
+    ml = b_leaf._gbdt.models_[0]
+    assert mw.num_leaves == LEAVES and ml.num_leaves == LEAVES
+
+
+def test_tail_halving_tightens_the_gap():
+    """wave_tail_halving spends at most half the remaining budget per
+    wave once it binds: the first tree must take MORE waves' worth of
+    splits (strictly later leaves get allocated by global gain), and
+    quality must not regress vs plain wave beyond noise."""
+    auc_wave, b_wave = _train_auc("wave")
+    auc_half, b_half = _train_auc("wave", wave_tail_halving=True)
+    # bounded: halving sits within noise of wave..leafwise
+    assert auc_half > auc_wave - 0.005, (auc_half, auc_wave)
+    # structural evidence the cap engaged: split_gain of the LAST splits
+    # under halving dominates the plain wave's tail (later splits are
+    # re-ranked globally instead of committed a wave early)
+    gw = np.sort(np.asarray(b_wave._gbdt.models_[0].split_gain))
+    gh = np.sort(np.asarray(b_half._gbdt.models_[0].split_gain))
+    assert gh[:10].sum() >= gw[:10].sum() * 0.9
+
+
+def test_leafwise_available_on_any_backend():
+    """tpu_growth_strategy=leafwise is the documented reference-parity
+    escape hatch; it must train on the CPU test backend too."""
+    auc_leaf, b = _train_auc("leafwise")
+    assert auc_leaf > 0.5
+    assert b._gbdt.growth_strategy == "leafwise"
