@@ -117,11 +117,6 @@ class Dataset:
                     self.feature_name = list(data.column_names)
                 elif hasattr(data, "columns"):
                     self.feature_name = list(map(str, data.columns))
-            # Arrow (arrow.h; LGBM_DatasetCreateFromArrow), pandas, and
-            # scipy CSR/CSC/COO (LGBM_DatasetCreateFromCSR/CSC) inputs are
-            # densified — device storage is dense binned tensors and EFB
-            # re-compresses exclusive sparse columns
-            data = _coerce_matrix(data)
             cat = []
             if self.categorical_feature not in ("auto", None):
                 for c in self.categorical_feature:
@@ -131,6 +126,39 @@ class Dataset:
                         cat.append(int(c))
             names = (None if self.feature_name == "auto"
                      else list(self.feature_name))
+            from .io.sparse import construct_from_sparse, is_scipy_sparse
+            if is_scipy_sparse(data) and not cfg.linear_tree:
+                # scipy CSR/CSC/COO (LGBM_DatasetCreateFromCSR/CSC) go
+                # CSC-direct-to-EFB-bundles: the dense [n, F] matrix is
+                # never materialized (ref: sparse_bin.hpp /
+                # multi_val_sparse_bin.hpp, redesigned as bundle codes —
+                # io/sparse.py).  linear_tree needs raw feature values,
+                # so it falls through to the dense path.
+                self._core = construct_from_sparse(
+                    data, label=self.label, weight=self.weight,
+                    group=self.group, init_score=self.init_score,
+                    max_bin=cfg.max_bin,
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    min_data_in_leaf=cfg.min_data_in_leaf,
+                    bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+                    categorical_feature=cat, feature_names=names,
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing,
+                    feature_pre_filter=cfg.feature_pre_filter,
+                    seed=cfg.data_random_seed,
+                    max_conflict_rate=cfg.max_conflict_rate,
+                    enable_bundle=cfg.enable_bundle,
+                    max_bin_by_feature=cfg.max_bin_by_feature or None,
+                    reference=ref_core)
+                if self.position is not None:
+                    self._core.metadata.set_position(self.position)
+                if self.free_raw_data:
+                    self.data = None
+                return self
+            # Arrow (arrow.h; LGBM_DatasetCreateFromArrow), pandas, and
+            # remaining inputs are densified — device storage is dense
+            # binned tensors and EFB re-compresses exclusive sparse columns
+            data = _coerce_matrix(data)
             if ref_core is not None:
                 self._core = ref_core.create_valid(
                     data, label=self.label, weight=self.weight,
@@ -767,6 +795,21 @@ class Booster:
     def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        from .io.sparse import is_scipy_sparse
+        if is_scipy_sparse(data) and data.shape[0] > 1:
+            # bounded-memory sparse prediction: densify row CHUNKS only
+            # (~64 MB each), never the whole matrix (ref: the CSR
+            # predictor paths of c_api.cpp predict row-wise too)
+            csr = data.tocsr()
+            chunk = max(1, (64 << 20) // max(8 * data.shape[1], 1))
+            parts = [
+                self.predict(csr[i:i + chunk].toarray(),
+                             start_iteration=start_iteration,
+                             num_iteration=num_iteration,
+                             raw_score=raw_score, pred_leaf=pred_leaf,
+                             pred_contrib=pred_contrib, **kwargs)
+                for i in range(0, data.shape[0], chunk)]
+            return np.concatenate(parts, axis=0)
         data = _coerce_matrix(data)
         if num_iteration is None:
             num_iteration = -1

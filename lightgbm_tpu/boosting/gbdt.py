@@ -77,10 +77,14 @@ def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
                          missing_type, num_bin, default_bin,
                          binned: np.ndarray, is_cat_node=None,
                          cat_boundaries_inner=None,
-                         cat_threshold_inner=None) -> np.ndarray:
+                         cat_threshold_inner=None,
+                         bundle_group=None, bundle_offset=None,
+                         bundle_zero_bin=None) -> np.ndarray:
     """Vectorized bin-space tree traversal on host (mirror of the device
     partition rule; ref: dense_bin.hpp:346-366 SplitInner + tree.h:372
-    CategoricalDecision over bin bitsets)."""
+    CategoricalDecision over bin bitsets).  When bundle_* arrays are
+    given, `binned` holds EFB bundle codes (sparse-ingested datasets)
+    and each node's feature bin is decoded from its bundle column."""
     from ..io.binning import MISSING_NAN, MISSING_ZERO
     n = binned.shape[1]
     if num_leaves <= 1:
@@ -96,7 +100,16 @@ def leaf_index_bin_space(split_feature_inner, threshold_bin, default_left,
             break
         nd = node[active]
         f = split_feature_inner[nd]
-        b = binned[f, np.nonzero(active)[0]]
+        if bundle_group is not None:
+            code = binned[bundle_group[f], np.nonzero(active)[0]]
+            code = code.astype(np.int64)
+            off = bundle_offset[f]
+            local = code - off
+            valid = (local >= 0) & (local < num_bin[f])
+            b = np.where(off == 0, code,
+                         np.where(valid, local, bundle_zero_bin[f]))
+        else:
+            b = binned[f, np.nonzero(active)[0]]
         mt = missing_type[f]
         is_missing = (((mt == MISSING_NAN) & (b == num_bin[f] - 1))
                       | ((mt == MISSING_ZERO) & (b == default_bin[f])))
@@ -220,7 +233,11 @@ class GBDT:
         # would silently lose bundling)
         voting_engages = (config.tree_learner == "voting"
                           and _mesh_size(config, len(jax.devices())) > 1)
-        if (config.enable_bundle and train_data.num_features > 1
+        if train_data.pre_bundled_plan is not None:
+            # sparse CSC-direct ingestion already produced bundle codes
+            # (io/sparse.py); never re-plan or densify
+            self.bundle_plan = train_data.pre_bundled_plan
+        elif (config.enable_bundle and train_data.num_features > 1
                 and not voting_engages):
             from ..io.bundle import build_bundled, plan_bundles
             plan = plan_bundles(binned, train_data.bin_mappers,
@@ -235,6 +252,14 @@ class GBDT:
                              train_data.max_num_bin - 1) <= 255 else np.int32
         self._n_device_cols = binned.shape[0]
         self.mesh = self._make_training_mesh(config)
+        if self._voting and train_data.pre_bundled_plan is not None:
+            # the PV-Tree vote is per-feature; bundle codes from sparse
+            # ingestion cannot vote — run the plain data-parallel
+            # histogram reduction over the same mesh instead
+            log.warning("tree_learner=voting needs per-feature bins; "
+                        "sparse pre-bundled datasets fall back to "
+                        "data-parallel histogram reduction")
+            self._voting = False
         self.binned_dev = self._put_by_row(
             _pad_rows(binned.astype(dtype), self.n_pad), axis=1,
             is_binned=True)
@@ -706,7 +731,7 @@ class GBDT:
                 lut[m.num_bin - 1] = np.nan
             elif m.missing_type == MISSING_ZERO:
                 lut[m.default_bin] = 0.0
-            X[:, f] = lut[np.clip(ds.binned[i], 0, m.num_bin - 1)]
+            X[:, f] = lut[np.clip(ds.feature_bins(i), 0, m.num_bin - 1)]
         return X
 
     def continue_from(self, prev: "GBDT", train_raw=None,
@@ -1204,11 +1229,19 @@ class GBDT:
             self._stop_training(stop_iter)
 
     # -------------------------------------------------------- score plumbing
-    def _tree_leaf_ids(self, tree: Tree, binned: np.ndarray) -> np.ndarray:
+    def _tree_leaf_ids(self, tree: Tree, ds) -> np.ndarray:
         """Bin-space leaf index of every row for a tree trained on this
-        dataset's bin mappers."""
+        dataset's bin mappers.  `ds` may store per-feature bins or (for
+        sparse-ingested data) bundle codes with its own plan."""
         from ..models.tree import K_CATEGORICAL_MASK
         ni = tree.num_leaves - 1
+        binned = ds.binned
+        plan = ds.pre_bundled_plan
+        bundle_kw = {}
+        if plan is not None:
+            bundle_kw = dict(bundle_group=plan.group_idx,
+                             bundle_offset=plan.offsets,
+                             bundle_zero_bin=plan.zero_bin)
         return leaf_index_bin_space(
             tree.split_feature_inner[:ni], tree.threshold_in_bin[:ni],
             (tree.decision_type[:ni] & 2) > 0,
@@ -1216,14 +1249,14 @@ class GBDT:
             self.f_missing_type, self.f_num_bin, self.f_default_bin, binned,
             is_cat_node=(tree.decision_type[:ni] & K_CATEGORICAL_MASK) > 0,
             cat_boundaries_inner=tree.cat_boundaries_inner,
-            cat_threshold_inner=tree.cat_threshold_inner)
+            cat_threshold_inner=tree.cat_threshold_inner, **bundle_kw)
 
     def _add_tree_score(self, tree: Tree, class_id: int,
                         train: bool = True, valid: bool = True) -> None:
         """score += tree's *current* leaf outputs (ref: score_updater.hpp:21
         AddScore; used by DART drop/normalize and RF averaging)."""
         if train:
-            ids = self._tree_leaf_ids(tree, self.train_data.binned)
+            ids = self._tree_leaf_ids(tree, self.train_data)
             # fixed-size leaf_vals so _score_update_fn compiles once
             L = max(self.config.num_leaves, 2)
             vals = np.zeros(L, np.float32)
@@ -1238,7 +1271,7 @@ class GBDT:
                     self.valid_scores[vi][class_id] += tree.predict(
                         np.asarray(vX, np.float64))
                 else:
-                    vids = self._tree_leaf_ids(tree, vds.binned)
+                    vids = self._tree_leaf_ids(tree, vds)
                     self.valid_scores[vi][class_id] += tree.leaf_value[vids]
 
     # ------------------------------------------------------------------- eval

@@ -91,6 +91,10 @@ class Dataset:
         self.metadata: Optional[Metadata] = None
         self.max_bin: int = 255
         self.raw_data: Optional[np.ndarray] = None       # kept for linear trees
+        # sparse CSC-direct ingestion (io/sparse.py): when set, `binned`
+        # holds [num_bundles, n] EFB bundle codes instead of per-feature
+        # bins, and this BundlePlan decodes them
+        self.pre_bundled_plan = None
 
     # ------------------------------------------------------------------
     @property
@@ -108,6 +112,24 @@ class Dataset:
 
     def inner_feature_index(self, original: int) -> int:
         return self.used_feature_map[original]
+
+    def feature_bins(self, inner: int) -> np.ndarray:
+        """Per-feature bin codes [n]; decodes bundle-space storage on
+        demand for sparse-ingested datasets (the bundle member's code
+        range is sliced out, everything else is the default bin — the
+        host-side mirror of Dataset::FixHistogram's member recovery)."""
+        plan = self.pre_bundled_plan
+        if plan is None:
+            return self.binned[inner]
+        g = int(plan.group_idx[inner])
+        off = int(plan.offsets[inner])
+        col = self.binned[g].astype(np.int32)
+        if off == 0:                     # singleton bundle: codes ARE bins
+            return col
+        local = col - off
+        nb = self.bin_mappers[self.used_features[inner]].num_bin
+        return np.where((local >= 0) & (local < nb), local,
+                        int(plan.zero_bin[inner]))
 
     # ------------------------------------------------------------------
     @classmethod
@@ -231,6 +253,7 @@ class Dataset:
         sub.used_features = self.used_features
         sub.max_bin = self.max_bin
         sub.binned = self.binned[:, used_indices]
+        sub.pre_bundled_plan = self.pre_bundled_plan
         md = Metadata(sub.num_data)
         src = self.metadata
         md.set_label(src.label[used_indices])
@@ -281,6 +304,17 @@ class Dataset:
                 "used_feature_map": self.used_feature_map,
                 "max_bin": self.max_bin,
                 "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+                "bundle_plan": (None if self.pre_bundled_plan is None else {
+                    "groups": [list(map(int, g))
+                               for g in self.pre_bundled_plan.groups],
+                    "group_idx": self.pre_bundled_plan.group_idx.tolist(),
+                    "offsets": self.pre_bundled_plan.offsets.tolist(),
+                    "zero_bin": self.pre_bundled_plan.zero_bin.tolist(),
+                    "in_bundle":
+                        self.pre_bundled_plan.in_bundle.astype(int).tolist(),
+                    "group_num_bin":
+                        self.pre_bundled_plan.group_num_bin.tolist(),
+                }),
             }).encode(), dtype=np.uint8))
 
     @classmethod
@@ -299,6 +333,16 @@ class Dataset:
         ds.max_bin = meta["max_bin"]
         ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
         ds.binned = z["binned"]
+        bp = meta.get("bundle_plan")
+        if bp is not None:
+            from .bundle import BundlePlan
+            ds.pre_bundled_plan = BundlePlan(
+                [list(g) for g in bp["groups"]],
+                np.asarray(bp["group_idx"], np.int32),
+                np.asarray(bp["offsets"], np.int32),
+                np.asarray(bp["zero_bin"], np.int32),
+                np.asarray(bp["in_bundle"], bool),
+                np.asarray(bp["group_num_bin"], np.int32))
         md = Metadata(ds.num_data)
         md.set_label(z["label"])
         if len(z["weight"]):

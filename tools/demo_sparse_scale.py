@@ -1,0 +1,66 @@
+"""Scale demo for CSC-direct sparse ingestion (VERDICT round-2 item 2):
+1M rows x 5000 features at ~0.5% density — a news20/Criteo-shaped mix of
+one-hot indicator blocks (EFB-compressible) and continuous sparse
+columns — ingested and trained WITHOUT ever materializing the 40 GB
+dense [n, F] float64 matrix.  Prints peak RSS and timings."""
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def rss_gb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def main():
+    n = 1_000_000
+    n_blocks, block = 45, 100          # 4500 one-hot indicator features
+    n_cont = 500                       # continuous sparse tail
+    rng = np.random.RandomState(0)
+
+    hot = rng.randint(0, block, size=(n, n_blocks))
+    oh_cols = (hot + np.arange(n_blocks)[None, :] * block).ravel()
+    oh_rows = np.repeat(np.arange(n), n_blocks)
+
+    nnz_c = int(n * n_cont * 0.005)
+    c_rows = rng.randint(0, n, size=nnz_c)
+    c_cols = n_blocks * block + rng.randint(0, n_cont, size=nnz_c)
+    c_vals = rng.randn(nnz_c).astype(np.float64)
+
+    F = n_blocks * block + n_cont
+    m = sp.csr_matrix(
+        (np.concatenate([np.ones(len(oh_rows)), c_vals]),
+         (np.concatenate([oh_rows, c_rows]),
+          np.concatenate([oh_cols, c_cols]))), shape=(n, F))
+    y = ((hot[:, 0] % 2 == 0) ^ (rng.rand(n) < 0.2)).astype(np.float64)
+    print(f"data: {n}x{F}, nnz={m.nnz} "
+          f"(density {m.nnz/(n*F):.4f}), rss={rss_gb():.2f} GB", flush=True)
+
+    import lightgbm_tpu as lgb
+    t0 = time.time()
+    ds = lgb.Dataset(m, label=y)
+    ds._core_or_construct()
+    cols = ds._core.binned.shape[0]
+    print(f"ingest: {time.time()-t0:.1f}s -> {cols} bundle columns, "
+          f"rss={rss_gb():.2f} GB", flush=True)
+
+    t0 = time.time()
+    b = lgb.train({"objective": "binary", "num_leaves": 31,
+                   "verbosity": -1, "metric": "none"}, ds,
+                  num_boost_round=10)
+    print(f"train 10 iters: {time.time()-t0:.1f}s, rss={rss_gb():.2f} GB",
+          flush=True)
+    pred = b.predict(m[:100_000])
+    acc = float(np.mean((pred > 0.5) == (y[:100_000] > 0.5)))
+    print(f"train-subset accuracy: {acc:.4f} (label noise 0.2 -> "
+          f"ceiling 0.8), rss={rss_gb():.2f} GB", flush=True)
+
+
+if __name__ == "__main__":
+    main()
