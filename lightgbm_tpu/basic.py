@@ -149,6 +149,7 @@ class Dataset:
                     max_conflict_rate=cfg.max_conflict_rate,
                     enable_bundle=cfg.enable_bundle,
                     max_bin_by_feature=cfg.max_bin_by_feature or None,
+                    forcedbins_filename=cfg.forcedbins_filename,
                     reference=ref_core)
                 if self.position is not None:
                     self._core.metadata.set_position(self.position)
@@ -176,7 +177,8 @@ class Dataset:
                     feature_pre_filter=cfg.feature_pre_filter,
                     seed=cfg.data_random_seed,
                     keep_raw_data=cfg.linear_tree or not self.free_raw_data,
-                    max_bin_by_feature=cfg.max_bin_by_feature or None)
+                    max_bin_by_feature=cfg.max_bin_by_feature or None,
+                    forcedbins_filename=cfg.forcedbins_filename)
         if self.position is not None:
             self._core.metadata.set_position(self.position)
         if self.free_raw_data and not isinstance(self.data, (str, bytes)):

@@ -298,18 +298,18 @@ class GBDT:
             log.fatal("Unknown monotone_constraints_method "
                       f"{config.monotone_constraints_method!r}")
         self._mono_intermediate = False
+        self._mono_advanced = False
         if has_mono and config.monotone_constraints_method != "basic":
-            if config.monotone_constraints_method == "advanced":
-                log.warning("monotone_constraints_method=advanced maps to "
-                            "intermediate on TPU (per-feature slack "
-                            "recomputation is inherently sequential)")
             if config.extra_trees or config.feature_fraction_bynode < 1.0:
-                log.warning("monotone_constraints_method=intermediate "
+                log.warning("monotone_constraints_method="
+                            f"{config.monotone_constraints_method} "
                             "falls back to basic with extra_trees / "
                             "feature_fraction_bynode (the full-tree "
                             "pending rescan has no per-leaf random state)")
             else:
                 self._mono_intermediate = True
+                self._mono_advanced = (
+                    config.monotone_constraints_method == "advanced")
         # CEGB (ref: cost_effective_gradient_boosting.hpp IsEnable)
         has_lazy = bool(config.cegb_penalty_feature_lazy)
         has_cegb = (config.cegb_tradeoff < 1.0
@@ -396,6 +396,7 @@ class GBDT:
             feature_fraction_bynode=config.feature_fraction_bynode,
             bynode_seed=config.feature_fraction_seed + 1,
             monotone_intermediate=self._mono_intermediate,
+            monotone_advanced=self._mono_advanced,
             wave_tail_halving=config.wave_tail_halving,
             # int8 MXU histogram path for quantized training (grid must
             # fit int8; hessian ints reach num_grad_quant_bins).  The

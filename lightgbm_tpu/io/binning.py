@@ -143,6 +143,77 @@ def find_bin_with_zero_as_one_bin(distinct_values: Sequence[float], counts: Sequ
     return bin_upper_bound
 
 
+def find_bin_with_predefined_bin(distinct_values: Sequence[float],
+                                 counts: Sequence[int], max_bin: int,
+                                 total_sample_cnt: int, min_data_in_bin: int,
+                                 forced_upper_bounds: Sequence[float]
+                                 ) -> List[float]:
+    """Forced bin upper bounds (forcedbins_filename), remaining bins
+    allocated greedily per forced interval in proportion to its sample
+    count (ref: src/io/bin.cpp:157-240 FindBinWithPredefinedBin)."""
+    num_distinct = len(distinct_values)
+    left_cnt = next((i for i, v in enumerate(distinct_values)
+                     if v > -K_ZERO_THRESHOLD), num_distinct)
+    right_start = next((i for i in range(left_cnt, num_distinct)
+                        if distinct_values[i] > K_ZERO_THRESHOLD), -1)
+
+    # zero bounds and the infinity bound come first (zero keeps its own
+    # bin exactly like FindBinWithZeroAsOneBin)
+    bin_upper_bound: List[float] = []
+    if max_bin == 2:
+        bin_upper_bound.append(K_ZERO_THRESHOLD if left_cnt == 0
+                               else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bin_upper_bound.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bin_upper_bound.append(K_ZERO_THRESHOLD)
+    bin_upper_bound.append(math.inf)
+
+    # forced bounds, excluding zeros (already bounded above)
+    max_to_insert = max_bin - len(bin_upper_bound)
+    num_inserted = 0
+    for v in forced_upper_bounds:
+        if num_inserted >= max_to_insert:
+            break
+        if abs(v) > K_ZERO_THRESHOLD:
+            bin_upper_bound.append(float(v))
+            num_inserted += 1
+    bin_upper_bound.sort()
+
+    # remaining bins: greedy inside each forced interval, proportional to
+    # its sample count; the last interval takes every remaining bin
+    free_bins = max_bin - len(bin_upper_bound)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    for i in range(len(bin_upper_bound)):
+        cnt_in_bin = 0
+        distinct_cnt_in_bin = 0
+        bin_start = value_ind
+        while (value_ind < num_distinct
+               and distinct_values[value_ind] < bin_upper_bound[i]):
+            cnt_in_bin += counts[value_ind]
+            distinct_cnt_in_bin += 1
+            value_ind += 1
+        bins_remaining = (max_bin - len(bin_upper_bound)
+                          - len(bounds_to_add))
+        # std::lround (half away from zero; operand is non-negative)
+        num_sub_bins = int(math.floor(cnt_in_bin * free_bins
+                                      / total_sample_cnt + 0.5))
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == len(bin_upper_bound) - 1:
+            num_sub_bins = bins_remaining + 1
+        new_bounds = greedy_find_bin(
+            distinct_values[bin_start:bin_start + distinct_cnt_in_bin],
+            counts[bin_start:bin_start + distinct_cnt_in_bin],
+            num_sub_bins, cnt_in_bin, min_data_in_bin)
+        bounds_to_add.extend(new_bounds[:-1])   # last bound is infinity
+    bin_upper_bound.extend(bounds_to_add)
+    bin_upper_bound.sort()
+    assert len(bin_upper_bound) <= max_bin
+    return bin_upper_bound
+
+
 def _need_filter(cnt_in_bin: List[int], total_cnt: int, filter_cnt: int,
                  bin_type: int) -> bool:
     """Pre-filter features that can never produce a valid split
@@ -259,20 +330,25 @@ class BinMapper:
 
         if bin_type == BIN_NUMERICAL:
             forced = list(forced_upper_bounds) if forced_upper_bounds else []
-            if forced:
-                log.warning("forced bin upper bounds: using greedy fallback merge")
+
+            def _find(mb, tc):
+                # ref: bin.cpp:302-309 FindBin dispatch — forced bounds
+                # select FindBinWithPredefinedBin
+                if forced:
+                    return find_bin_with_predefined_bin(
+                        distinct_values, counts, mb, tc, min_data_in_bin,
+                        forced)
+                return find_bin_with_zero_as_one_bin(
+                    distinct_values, counts, mb, tc, min_data_in_bin)
+
             if self.missing_type == MISSING_ZERO:
-                bounds = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                bounds = _find(max_bin, total_sample_cnt)
                 if len(bounds) == 2:
                     self.missing_type = MISSING_NONE
             elif self.missing_type == MISSING_NONE:
-                bounds = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                bounds = _find(max_bin, total_sample_cnt)
             else:  # NaN: last bin reserved for missing (ref: bin.cpp:391-394)
-                bounds = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin - 1,
-                    total_sample_cnt - na_cnt, min_data_in_bin)
+                bounds = _find(max_bin - 1, total_sample_cnt - na_cnt)
                 bounds = bounds + [math.nan]
             self.bin_upper_bound = np.array(bounds, dtype=np.float64)
             self.num_bin = len(bounds)

@@ -67,21 +67,18 @@ def _default_bins(mappers, used_features) -> np.ndarray:
     return zb
 
 
-def plan_bundles(binned: np.ndarray, mappers, used_features,
-                 max_conflict_rate: float = 0.0,
-                 rng: Optional[np.random.RandomState] = None) -> BundlePlan:
-    """Greedy conflict-bounded bundling (ref: dataset.cpp FindGroups):
-    features ordered by non-default count descending; each joins the
-    first bundle whose accumulated conflicts stay under the cap."""
-    F, n = binned.shape
-    zb = _default_bins(mappers, used_features)
-    sample = (np.arange(n) if n <= _SAMPLE else
-              (rng or np.random.RandomState(3)).choice(n, _SAMPLE, False))
-    sub = binned[:, sample]
-    nz = sub != zb[:, None]                       # [F, S] non-default mask
-    nz_cnt = nz.sum(axis=1)
-    nbins = np.array([mappers[f].num_bin for f in used_features], np.int32)
-    cap = max_conflict_rate * len(sample)
+def plan_bundles_from_masks(nz, nbins: np.ndarray, zb: np.ndarray,
+                            sample_size: int,
+                            max_conflict_rate: float) -> BundlePlan:
+    """Greedy conflict-bounded bundling core (ref: dataset.cpp
+    FindGroups): features ordered by non-default count descending; each
+    joins the first bundle whose accumulated conflicts stay under the
+    cap.  `nz` is the [F, S] non-default mask over the row sample (any
+    indexable of bool vectors); shared by the dense and the
+    CSC-direct-sparse planners so their plans cannot diverge."""
+    F = len(nbins)
+    nz_cnt = np.array([int(nz[f].sum()) for f in range(F)], np.int64)
+    cap = max_conflict_rate * sample_size
 
     order = np.argsort(-nz_cnt)
     groups: List[List[int]] = []
@@ -104,7 +101,7 @@ def plan_bundles(binned: np.ndarray, mappers, used_features,
                 break
         if not placed:
             groups.append([f])
-            group_nz.append(nz[f].copy())
+            group_nz.append(np.array(nz[f], copy=True))
             group_conflicts.append(0)
             group_bins.append(1 + int(nbins[f]))
 
@@ -128,6 +125,21 @@ def plan_bundles(binned: np.ndarray, mappers, used_features,
         group_num_bin[gi] = off
     return BundlePlan(groups, group_idx, offsets, zb, in_bundle,
                       group_num_bin)
+
+
+def plan_bundles(binned: np.ndarray, mappers, used_features,
+                 max_conflict_rate: float = 0.0,
+                 rng: Optional[np.random.RandomState] = None) -> BundlePlan:
+    """Dense-binned front end of the planner."""
+    F, n = binned.shape
+    zb = _default_bins(mappers, used_features)
+    sample = (np.arange(n) if n <= _SAMPLE else
+              (rng or np.random.RandomState(3)).choice(n, _SAMPLE, False))
+    sub = binned[:, sample]
+    nz = sub != zb[:, None]                       # [F, S] non-default mask
+    nbins = np.array([mappers[f].num_bin for f in used_features], np.int32)
+    return plan_bundles_from_masks(nz, nbins, zb, len(sample),
+                                   max_conflict_rate)
 
 
 def build_bundled(binned: np.ndarray, plan: BundlePlan) -> np.ndarray:

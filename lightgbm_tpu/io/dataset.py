@@ -77,6 +77,41 @@ class Metadata:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
 
+def get_forced_bins(path: str, num_total_features: int,
+                    categorical_features=()) -> List[List[float]]:
+    """forcedbins_filename JSON -> per-feature forced bin upper bounds
+    (ref: dataset_loader.cpp:1493 GetForcedBins): a list of
+    {"feature": i, "bin_upper_bound": [...]} records; missing file warns
+    and is ignored, categorical features warn and are skipped,
+    consecutive duplicates are removed."""
+    forced: List[List[float]] = [[] for _ in range(num_total_features)]
+    if not path:
+        return forced
+    try:
+        with open(path) as f:
+            arr = json.load(f)
+    except OSError:
+        log.warning(f"Could not open {path}. Will ignore.")
+        return forced
+    cat = set(categorical_features or ())
+    for rec in arr:
+        fnum = int(rec["feature"])
+        if fnum >= num_total_features or fnum < 0:
+            log.fatal(f"forced bins feature index {fnum} out of range")
+        if fnum in cat:
+            log.warning(f"Feature {fnum} is categorical. Will ignore "
+                        "forced bins for this feature.")
+            continue
+        forced[fnum].extend(float(v) for v in rec["bin_upper_bound"])
+    for i in range(num_total_features):
+        deduped: List[float] = []
+        for v in forced[i]:
+            if not deduped or deduped[-1] != v:
+                deduped.append(v)
+        forced[i] = deduped
+    return forced
+
+
 class Dataset:
     """Binned training data (ref: include/LightGBM/dataset.h:486 `class Dataset`)."""
 
@@ -152,7 +187,8 @@ class Dataset:
             seed: int = 1,
             keep_raw_data: bool = False,
             reference: Optional["Dataset"] = None,
-            max_bin_by_feature: Optional[Sequence[int]] = None) -> "Dataset":
+            max_bin_by_feature: Optional[Sequence[int]] = None,
+            forcedbins_filename: str = "") -> "Dataset":
         """Build a Dataset from a dense float matrix
         (ref: dataset_loader.cpp:593 ConstructFromSampleData + :1263 ExtractFeatures).
 
@@ -190,6 +226,8 @@ class Dataset:
             else:
                 sample = data
             total_sample_cnt = len(sample)
+            forced_bins = get_forced_bins(forcedbins_filename, num_features,
+                                          cat_set)
             ds.bin_mappers = []
             for f in range(num_features):
                 col = sample[:, f]
@@ -205,7 +243,8 @@ class Dataset:
                     min_split_data=min_data_in_leaf,
                     pre_filter=feature_pre_filter,
                     bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
-                    use_missing=use_missing, zero_as_missing=zero_as_missing)
+                    use_missing=use_missing, zero_as_missing=zero_as_missing,
+                    forced_upper_bounds=forced_bins[f])
                 ds.bin_mappers.append(mapper)
             ds.used_feature_map = []
             ds.used_features = []
@@ -396,6 +435,7 @@ def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = 
             feats, label=labels, weight=weight, group=group,
             max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
             min_data_in_leaf=cfg.min_data_in_leaf,
+            forcedbins_filename=cfg.forcedbins_filename,
             bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
             categorical_feature=cat_features,
             feature_names=names, use_missing=cfg.use_missing,

@@ -38,8 +38,8 @@ import numpy as np
 from ..utils import log
 from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper, \
     prep_find_bin_values
-from .bundle import MAX_BUNDLE_BINS, _SAMPLE, BundlePlan
-from .dataset import Dataset, Metadata
+from .bundle import _SAMPLE, plan_bundles_from_masks
+from .dataset import Dataset, Metadata, get_forced_bins
 
 
 def is_scipy_sparse(data) -> bool:
@@ -62,6 +62,7 @@ def construct_from_sparse(
         max_conflict_rate: float = 0.0,
         enable_bundle: bool = True,
         max_bin_by_feature: Optional[Sequence[int]] = None,
+        forcedbins_filename: str = "",
         reference: Optional[Dataset] = None) -> Dataset:
     """Build a Dataset from a scipy sparse matrix, CSC-direct-to-bundles.
 
@@ -105,6 +106,8 @@ def construct_from_sparse(
             sample_csc = csc
         total_sample_cnt = sample_csc.shape[0]
         cat_set = set(categorical_feature or [])
+        forced_bins = get_forced_bins(forcedbins_filename, num_features,
+                                      cat_set)
         ds.bin_mappers = []
         for f in range(num_features):
             col_vals = sample_csc.data[
@@ -120,7 +123,8 @@ def construct_from_sparse(
                 pre_filter=feature_pre_filter,
                 bin_type=(BIN_CATEGORICAL if f in cat_set
                           else BIN_NUMERICAL),
-                use_missing=use_missing, zero_as_missing=zero_as_missing)
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                forced_upper_bounds=forced_bins[f])
             ds.bin_mappers.append(mapper)
         ds.used_feature_map = []
         ds.used_features = []
@@ -131,7 +135,14 @@ def construct_from_sparse(
                 ds.used_feature_map.append(len(ds.used_features))
                 ds.used_features.append(f)
 
-    # --- nonzero bin codes per used feature (O(nnz), no dense bins) ---
+    # --- nonzero bin codes per used feature (O(nnz), no dense bins).
+    # TWO distinct "default" notions: the FILL bin (what an absent/zero
+    # entry bins to, values_to_bins(0.0) for both types) and the bundle
+    # PLAN default (bundle.py _default_bins: fill bin for numerical, the
+    # NaN/other bin 0 for categorical).  When they differ (a categorical
+    # whose category 0 is a real bin), the column is NOT sparse in bundle
+    # terms — its implied rows are non-default — and is materialized
+    # per-column so the plan and codes match the dense path exactly. ---
     nz_rows: List[np.ndarray] = []
     nz_bins: List[np.ndarray] = []
     zero_bin = np.zeros(len(ds.used_features), np.int32)
@@ -141,16 +152,20 @@ def construct_from_sparse(
         s, e = csc.indptr[f], csc.indptr[f + 1]
         rows = np.asarray(csc.indices[s:e])
         bins = m.values_to_bins(np.asarray(csc.data[s:e], np.float64))
-        # same default-bin convention as the dense planner
-        # (bundle.py _default_bins): bin of 0.0 for numerical, the
-        # NaN/other bin (0) for categorical
-        zb = (int(m.values_to_bins(np.zeros(1))[0])
-              if m.bin_type == BIN_NUMERICAL else 0)
-        zero_bin[inner] = zb
+        fill = int(m.values_to_bins(np.zeros(1))[0])
+        pzb = fill if m.bin_type == BIN_NUMERICAL else 0
+        zero_bin[inner] = pzb
         nbins[inner] = m.num_bin
-        keep = bins != zb      # entries binning to the default act absent
-        nz_rows.append(rows[keep])
-        nz_bins.append(bins[keep].astype(np.int32))
+        if fill == pzb:
+            keep = bins != pzb   # entries binning to the default act absent
+            nz_rows.append(rows[keep])
+            nz_bins.append(bins[keep].astype(np.int32))
+        else:
+            col = np.full(n, fill, np.int32)
+            col[rows] = bins
+            nzr = np.nonzero(col != pzb)[0]
+            nz_rows.append(nzr)
+            nz_bins.append(col[nzr])
 
     # --- conflict-bounded greedy bundling over a row sample (mirrors
     # io/bundle.py plan_bundles; ref: dataset.cpp FindGroups).  A
@@ -207,57 +222,16 @@ def construct_from_sparse(
         # the SAME plan so train and valid bundle columns align
         plan = ref_plan
     else:
-        # non-default counts over the SAME row sample the dense path
-        # uses, so the greedy order (and hence the whole plan) is
-        # identical to plan_bundles on the densified matrix
-        nz_cnt = np.array([int(sample_mask(f).sum()) for f in range(F)],
-                          np.int64)
-        cap = max_conflict_rate * sample_size
-        order = np.argsort(-nz_cnt)
-        groups: List[List[int]] = []
-        group_nz: List[np.ndarray] = []
-        group_conflicts: List[int] = []
-        group_bins: List[int] = []
-        for f in order:
-            f = int(f)
-            mask = sample_mask(f)
-            placed = False
-            for gi in range(len(groups)):
-                if group_bins[gi] + nbins[f] > MAX_BUNDLE_BINS:
-                    continue
-                conflicts = int((group_nz[gi] & mask).sum())
-                if group_conflicts[gi] + conflicts <= cap:
-                    groups[gi].append(f)
-                    group_nz[gi] |= mask
-                    group_conflicts[gi] += conflicts
-                    group_bins[gi] += int(nbins[f])
-                    placed = True
-                    break
-            if not placed:
-                groups.append([f])
-                group_nz.append(mask)
-                group_conflicts.append(0)
-                group_bins.append(1 + int(nbins[f]))
+        # the shared greedy planner core over the SAME row sample the
+        # dense path uses, so the plan is identical to plan_bundles on
+        # the densified matrix
 
-        group_idx = np.zeros(F, np.int32)
-        offsets = np.zeros(F, np.int32)
-        in_bundle = np.zeros(F, bool)
-        group_num_bin = np.zeros(len(groups), np.int32)
-        for gi, members in enumerate(groups):
-            if len(members) == 1:
-                f0 = members[0]
-                group_idx[f0] = gi
-                group_num_bin[gi] = nbins[f0]
-                continue
-            off = 1
-            for f0 in members:
-                group_idx[f0] = gi
-                offsets[f0] = off
-                in_bundle[f0] = True
-                off += int(nbins[f0])
-            group_num_bin[gi] = off
-        plan = BundlePlan(groups, group_idx, offsets, zero_bin, in_bundle,
-                          group_num_bin)
+        class _LazyMasks:
+            def __getitem__(self, f):
+                return sample_mask(f)
+
+        plan = plan_bundles_from_masks(_LazyMasks(), nbins, zero_bin,
+                                       sample_size, max_conflict_rate)
 
     # --- bundle-code matrix [num_bundles, n]: the ONLY dense object ---
     dtype = np.uint8 if int(plan.group_num_bin.max(initial=1)) <= 256 \

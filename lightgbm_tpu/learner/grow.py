@@ -117,6 +117,12 @@ class GrowParams(NamedTuple):
     # few extra cheap waves).  See PERF_NOTES.md for the measured
     # wave-vs-leafwise AUC gap this addresses.
     wave_tail_halving: bool = False
+    # monotone_constraints_method=advanced (ref:
+    # monotone_constraints.hpp:858 AdvancedLeafConstraints): per-(leaf,
+    # feature, threshold) constraint surfaces derived from the leaf
+    # rects instead of the intermediate mode's whole-leaf scalar.
+    # Requires monotone_intermediate.
+    monotone_advanced: bool = False
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -375,7 +381,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     def best_of(hist, sum_g, sum_h, cnt, parent_out, cmin=None, cmax=None,
                 depth=None, rand_tag=0, used=None, branch=None,
-                member_mask=None, lazy_mask=None, lazy_used_cur=None):
+                member_mask=None, lazy_mask=None, lazy_used_cur=None,
+                adv=None):
         cm = col_mask
         if params.interaction_sets:
             cm = cm & allowed_of(branch)
@@ -400,6 +407,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             kw.update(monotone=meta.monotone, constraint_min=cmin,
                       constraint_max=cmax,
                       mono_penalty=mono_penalty_of(depth))
+            if adv is not None:
+                # advanced mode: per-child [F, B] constraint surfaces
+                kw.update(constraint_min_left=adv[0],
+                          constraint_max_left=adv[1],
+                          constraint_min_right=adv[2],
+                          constraint_max_right=adv[3])
         if sp.extra_trees:
             kw["rand_bin"] = _rand_bins(rand_tag)
             if sp.has_categorical:
@@ -845,14 +858,130 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 branch_all = (leaf_branch if params.interaction_sets
                               else jnp.zeros((L, 1), bool))
 
-                def _rescan(h, sg, sh, c, po, mn, mx, d, br):
-                    return best_of(h, sg, sh, c, po, mn, mx, d,
-                                   rand_tag=0, used=used_vec, branch=br)
+                if params.monotone_advanced:
+                    # --- advanced mode (ref: monotone_constraints.hpp:858
+                    # AdvancedLeafConstraints).  TPU redesign: instead of
+                    # the reference's per-threshold constraint lists built
+                    # by recursive tree crawls, derive PER-(leaf, feature,
+                    # threshold) constraint surfaces from the leaf rects.
+                    # A candidate split of leaf i on feature f at bin t
+                    # makes children whose rects differ from i's only
+                    # along f, so whether neighbor j bounds a child via
+                    # monotone feature f' reduces to threshold-interval
+                    # conditions on t — each contribution is a prefix or
+                    # suffix interval of bins, aggregated with scatter-min
+                    # plus a cumulative min/max along the bin axis.
+                    i32_ = jnp.int32
+                    inf = jnp.inf
+                    inc, dec = incf, decf
+                    novi = nov.astype(jnp.int32)           # [L, L, F]
+                    # j bounds i above/below via feature g (parent rects)
+                    sA = (below & inc) | (belowT & dec)    # [L, L, F]
+                    sB = (belowT & inc) | (below & dec)
+                    valid0 = (alive[None, :] & ~jnp.eye(L, dtype=bool))
+                    # all features except f overlap / exactly one other
+                    # non-overlapping feature
+                    contig0 = (n_false[:, :, None] - novi) == 0
+                    contig1 = (n_false[:, :, None] - novi) == 1
+                    sA_any = jnp.sum(sA.astype(i32_), axis=2)
+                    sB_any = jnp.sum(sB.astype(i32_), axis=2)
+                    qual3A = contig1 & ((sA_any[:, :, None]
+                                         - sA.astype(i32_)) >= 1)
+                    qual3B = contig1 & ((sB_any[:, :, None]
+                                         - sB.astype(i32_)) >= 1)
+                    v0 = valid0[:, :, None]
+                    lo_j = leaf_lo[None, :, :]             # [1, L, F]
+                    hi_j = leaf_hi[None, :, :]
+                    lo_i = leaf_lo[:, None, :]
+                    hi_i = leaf_hi[:, None, :]
+                    ovf = lo_i < hi_j                      # child-f overlap
+                    ovf_r = lo_j < hi_i
+                    B_ = B
+                    ii = jnp.broadcast_to(
+                        jnp.arange(L, dtype=i32_)[:, None, None], below.shape)
+                    ff = jnp.broadcast_to(
+                        jnp.arange(num_features,
+                                   dtype=i32_)[None, None, :], below.shape)
+                    ojb = jnp.broadcast_to(outj, below.shape)
 
-                res = jax.vmap(_rescan)(
-                    hist_stack, new_sum_g, new_sum_h, tree.leaf_count,
-                    tree.leaf_value, leaf_cmin, leaf_cmax, tree.leaf_depth,
-                    branch_all)
+                    def smin(gate, pos):
+                        """[L, F, B] scatter-min of out_j at bin pos."""
+                        p = jnp.where(gate & (pos >= 0), pos, B_)
+                        return (jnp.full((L, num_features, B_ + 1), inf)
+                                .at[ii, ff, p].min(
+                                    jnp.where(gate, ojb, inf))[:, :, :B_])
+
+                    def smax(gate, pos):
+                        p = jnp.where(gate & (pos >= 0), pos, B_)
+                        return (jnp.full((L, num_features, B_ + 1), -inf)
+                                .at[ii, ff, p].max(
+                                    jnp.where(gate, ojb, -inf))[:, :, :B_])
+
+                    cummin_f = lambda a: jax.lax.cummin(a, axis=2)
+                    cummin_r = lambda a: jax.lax.cummin(a, axis=2,
+                                                        reverse=True)
+                    cummax_f = lambda a: jax.lax.cummax(a, axis=2)
+                    cummax_r = lambda a: jax.lax.cummax(a, axis=2,
+                                                        reverse=True)
+
+                    def cst(gate):
+                        """[L, F] constant min over qualifying j."""
+                        return jnp.min(jnp.where(gate, ojb, inf), axis=1)
+
+                    def cst_max(gate):
+                        return jnp.max(jnp.where(gate, ojb, -inf), axis=1)
+
+                    # UPPER bounds, LEFT child ([lo_i, t+1) along f):
+                    #  f'=f inc: t < lo_j  -> bins [0, lo_j): suffix min
+                    #  f'=f dec: hi_j <= lo_i (belowT): all t
+                    #  f'!=f: parent side + child overlaps j along f:
+                    #         t >= lo_j -> prefix min
+                    uL = jnp.minimum(
+                        cummin_r(smin(v0 & contig0 & inc, lo_j - 1)),
+                        cst(v0 & contig0 & dec & belowT)[:, :, None])
+                    uL = jnp.minimum(
+                        uL, cummin_f(smin(v0 & qual3A & ovf, lo_j)))
+                    # UPPER bounds, RIGHT child ([t+1, hi_i)):
+                    #  f'=f inc: hi_i <= lo_j (below): all t
+                    #  f'=f dec: t >= hi_j - 1 -> prefix min
+                    #  f'!=f: t <= hi_j - 2 -> suffix min
+                    uR = jnp.minimum(
+                        cst(v0 & contig0 & inc & below)[:, :, None],
+                        cummin_f(smin(v0 & contig0 & dec, hi_j - 1)))
+                    uR = jnp.minimum(
+                        uR, cummin_r(smin(v0 & qual3A & ovf_r, hi_j - 2)))
+                    # LOWER bounds mirror with sB / swapped sides
+                    lL = jnp.maximum(
+                        cummax_r(smax(v0 & contig0 & dec, lo_j - 1)),
+                        cst_max(v0 & contig0 & inc & belowT)[:, :, None])
+                    lL = jnp.maximum(
+                        lL, cummax_f(smax(v0 & qual3B & ovf, lo_j)))
+                    lR = jnp.maximum(
+                        cst_max(v0 & contig0 & dec & below)[:, :, None],
+                        cummax_f(smax(v0 & contig0 & inc, hi_j - 1)))
+                    lR = jnp.maximum(
+                        lR, cummax_r(smax(v0 & qual3B & ovf_r, hi_j - 2)))
+                    adv_all = (lL, uL, lR, uR)
+
+                    def _rescan(h, sg, sh, c, po, mn, mx, d, br, a0, a1,
+                                a2, a3):
+                        return best_of(h, sg, sh, c, po, mn, mx, d,
+                                       rand_tag=0, used=used_vec, branch=br,
+                                       adv=(a0, a1, a2, a3))
+
+                    res = jax.vmap(_rescan)(
+                        hist_stack, new_sum_g, new_sum_h, tree.leaf_count,
+                        tree.leaf_value, leaf_cmin, leaf_cmax,
+                        tree.leaf_depth, branch_all, *adv_all)
+                else:
+                    def _rescan(h, sg, sh, c, po, mn, mx, d, br):
+                        return best_of(h, sg, sh, c, po, mn, mx, d,
+                                       rand_tag=0, used=used_vec, branch=br)
+
+                    res = jax.vmap(_rescan)(
+                        hist_stack, new_sum_g, new_sum_h, tree.leaf_count,
+                        tree.leaf_value, leaf_cmin, leaf_cmax,
+                        tree.leaf_depth, branch_all)
                 pending = _PendingSplits(
                     gain=jnp.where(alive, res.gain, K_MIN_SCORE),
                     feature=res.feature, threshold=res.threshold,

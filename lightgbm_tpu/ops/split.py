@@ -315,6 +315,10 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
                     monotone: jnp.ndarray = None,
                     constraint_min: jnp.ndarray = None,
                     constraint_max: jnp.ndarray = None,
+                    constraint_min_left: jnp.ndarray = None,
+                    constraint_max_left: jnp.ndarray = None,
+                    constraint_min_right: jnp.ndarray = None,
+                    constraint_max_right: jnp.ndarray = None,
                     mono_penalty: jnp.ndarray = None,
                     cegb_lazy_cost: jnp.ndarray = None,
                     rand_cat_u: jnp.ndarray = None,
@@ -377,15 +381,28 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         if params.has_monotone:
             # constrained gain for monotone features: outputs clamped to
             # the leaf's [min, max]; ordering violations score 0
-            # (feature_histogram.hpp:758-797 GetSplitGains USE_MC branch)
+            # (feature_histogram.hpp:758-797 GetSplitGains USE_MC branch).
+            # Advanced mode (monotone_constraints.hpp:858
+            # AdvancedLeafConstraints) passes PER-CHILD, PER-THRESHOLD
+            # [F, B] constraint surfaces instead of the leaf scalar.
             mc = monotone[:, None]
+            cmin_l = (constraint_min_left if constraint_min_left is not None
+                      else constraint_min)
+            cmax_l = (constraint_max_left if constraint_max_left is not None
+                      else constraint_max)
+            cmin_r = (constraint_min_right
+                      if constraint_min_right is not None
+                      else constraint_min)
+            cmax_r = (constraint_max_right
+                      if constraint_max_right is not None
+                      else constraint_max)
             lout = jnp.clip(leaf_output(left_g, left_h, left_c.astype(f32),
                                         parent_output, params),
-                            constraint_min, constraint_max)
+                            cmin_l, cmax_l)
             rout = jnp.clip(leaf_output(right_g, right_h,
                                         right_c.astype(f32),
                                         parent_output, params),
-                            constraint_min, constraint_max)
+                            cmin_r, cmax_r)
             bad = (((mc > 0) & (lout > rout)) | ((mc < 0) & (lout < rout)))
             # clamping applies to EVERY feature once the leaf is
             # constrained (USE_MC templates the whole learner); the
@@ -546,9 +563,25 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
 
     if params.has_monotone:
         # the leaf's [min, max] clamps the winner's stored outputs too
-        # (CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:740)
-        left_out = jnp.clip(left_out, constraint_min, constraint_max)
-        right_out = jnp.clip(right_out, constraint_min, constraint_max)
+        # (CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:740).
+        # Advanced mode clamps with the constraint surface AT the winning
+        # (feature, threshold); categorical winners keep the conservative
+        # whole-leaf scalar (their surfaces are threshold-indexed).
+        if constraint_min_left is not None:
+            thr_n = best_thr_f[best_f]
+            lmin_w = jnp.where(is_cat_out, constraint_min,
+                               constraint_min_left[best_f, thr_n])
+            lmax_w = jnp.where(is_cat_out, constraint_max,
+                               constraint_max_left[best_f, thr_n])
+            rmin_w = jnp.where(is_cat_out, constraint_min,
+                               constraint_min_right[best_f, thr_n])
+            rmax_w = jnp.where(is_cat_out, constraint_max,
+                               constraint_max_right[best_f, thr_n])
+            left_out = jnp.clip(left_out, lmin_w, lmax_w)
+            right_out = jnp.clip(right_out, rmin_w, rmax_w)
+        else:
+            left_out = jnp.clip(left_out, constraint_min, constraint_max)
+            right_out = jnp.clip(right_out, constraint_min, constraint_max)
 
     return SplitResult(
         gain=g_, feature=best_f, threshold=thr_out,

@@ -118,17 +118,63 @@ def test_intermediate_mode_monotone_and_tighter_fit():
     assert mse_int <= mse_basic * 1.02, (mse_int, mse_basic)
 
 
-def test_advanced_mode_maps_to_intermediate():
-    X, y = _problem(n=1500)
-    b = lgb.train({"objective": "regression", "num_leaves": 15,
-                   "verbosity": -1, "min_data_in_leaf": 5,
-                   "monotone_constraints": [1, -1, 0],
-                   "monotone_constraints_method": "advanced",
-                   "tpu_growth_strategy": "leafwise"},
-                  lgb.Dataset(X, label=y), num_boost_round=8)
-    assert b._gbdt.grow_params.monotone_intermediate
-    assert _is_monotone(b, 0, +1)
-    assert _is_monotone(b, 1, -1)
+def test_advanced_mode_monotone_and_at_least_intermediate_fit():
+    """monotone_constraints_method=advanced (ref:
+    monotone_constraints.hpp:858 AdvancedLeafConstraints): per-(feature,
+    threshold) constraint surfaces are looser than the intermediate
+    whole-leaf scalar, so the fit must be at least as good, while every
+    feature slice stays monotone."""
+    X, y = _problem()
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "learning_rate": 0.2, "min_data_in_leaf": 5,
+            "monotone_constraints": [1, -1, 0],
+            "tpu_growth_strategy": "leafwise"}
+    b_int = lgb.train({**base,
+                       "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=20)
+    b_adv = lgb.train({**base, "monotone_constraints_method": "advanced"},
+                      lgb.Dataset(X, label=y), num_boost_round=20)
+    assert b_adv._gbdt.grow_params.monotone_advanced
+    rng = np.random.RandomState(11)
+    for _ in range(10):
+        others = tuple(rng.rand(2))
+        assert _is_monotone(b_adv, 0, +1, others)
+        assert _is_monotone(b_adv, 1, -1, others)
+    mse_int = float(np.mean((b_int.predict(X) - y) ** 2))
+    mse_adv = float(np.mean((b_adv.predict(X) - y) ** 2))
+    # looser (per-threshold) constraints must not fit WORSE
+    assert mse_adv <= mse_int * 1.005, (mse_adv, mse_int)
+
+
+def test_advanced_differs_from_intermediate_when_slack_matters():
+    """A landscape where a far leaf constrains the whole leaf under
+    intermediate but only part of the threshold range under advanced:
+    the two modes must produce different models (the slack is real)."""
+    rng = np.random.RandomState(3)
+    n = 3000
+    X = rng.rand(n, 2)
+    y = (np.where(X[:, 0] > 0.5, 2.0, 0.0) * (0.5 + X[:, 1])
+         + 0.05 * rng.randn(n))
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "monotone_constraints": [1, 0],
+            "tpu_growth_strategy": "leafwise"}
+    b_int = lgb.train({**base,
+                       "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    b_adv = lgb.train({**base, "monotone_constraints_method": "advanced"},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    assert b_int.model_to_string() != b_adv.model_to_string()
+    assert _is_monotone_2f(b_adv)
+
+
+def _is_monotone_2f(booster):
+    grid = np.linspace(0.01, 0.99, 50)
+    for x1 in np.linspace(0.05, 0.95, 7):
+        X = np.column_stack([grid, np.full(50, x1)])
+        d = np.diff(booster.predict(X))
+        if not (d >= -1e-10).all():
+            return False
+    return True
 
 
 def test_intermediate_falls_back_with_extra_trees():

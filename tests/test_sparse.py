@@ -152,3 +152,27 @@ def test_sparse_enable_bundle_false_keeps_per_feature_bins():
     b_dense = lgb.train(p, lgb.Dataset(np.asarray(m.todense()), label=y,
                                        params=p), num_boost_round=5)
     assert b_sparse.model_to_string() == b_dense.model_to_string()
+
+
+def test_sparse_categorical_matches_dense_path():
+    """Categorical features whose category 0 is a real observed bin used
+    to diverge from the dense path (absent entries were filled with the
+    bundle default instead of bin(0)); models must match bit-for-bit."""
+    rng = np.random.RandomState(5)
+    n = 3000
+    X = np.zeros((n, 4))
+    X[:, 0] = rng.randn(n)
+    X[:, 1] = np.where(rng.rand(n) < 0.7, 0.0,
+                       rng.randint(1, 6, n)).astype(float)  # sparse cat
+    X[:, 2] = np.where(rng.rand(n) < 0.8, 0.0, rng.randn(n))
+    X[:, 3] = rng.randint(0, 3, n).astype(float)            # dense-ish cat
+    y = ((X[:, 1] == 0) & (X[:, 0] > 0)).astype(np.float64)
+    import scipy.sparse as sp2
+    m = sp2.csr_matrix(X)
+    p = {**PARAMS, "min_data_in_leaf": 10}
+    b_sp = lgb.train(p, lgb.Dataset(m, label=y, categorical_feature=[1, 3]),
+                     num_boost_round=6)
+    b_dn = lgb.train(p, lgb.Dataset(X, label=y, categorical_feature=[1, 3]),
+                     num_boost_round=6)
+    assert b_sp.model_to_string() == b_dn.model_to_string()
+    np.testing.assert_array_equal(b_sp.predict(m), b_dn.predict(X))
