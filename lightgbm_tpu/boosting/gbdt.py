@@ -55,10 +55,25 @@ def _goss_sample(grad, hess, pad_mask, key, top_k, other_k):
     return keep, grad * scale[None, :], hess * scale[None, :]
 
 
+def _fetch_host(a) -> np.ndarray:
+    """Device -> host fetch that also works for multi-process arrays:
+    np.asarray refuses ANY array spanning non-addressable devices, but the
+    packed tree buffer is pinned fully-replicated under multi-process
+    SPMD (see _pack_tree_fn), so the local shard IS the whole value."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        return np.asarray(a.addressable_shards[0].data)
+    return np.asarray(a)
+
+
 def _mesh_size(config, ndev: int) -> int:
     """Device-mesh size policy shared by the EFB gate and
-    _make_training_mesh: num_machines caps the local device count
-    (ref: config.h num_machines; application.cpp:100 machine setup)."""
+    _make_training_mesh (ref: config.h num_machines; application.cpp:100
+    machine setup).  Under multi-process SPMD the machine list already
+    defines the cluster, so the mesh spans every global device; in a
+    single process num_machines caps the local device count (mesh
+    emulation of an N-machine run)."""
+    if jax.process_count() > 1:
+        return ndev
     want = config.num_machines if config.num_machines > 1 else ndev
     return min(want, ndev)
 
@@ -615,7 +630,18 @@ class GBDT:
                 as_f32(t.leaf_parent), as_f32(t.leaf_depth),
                 as_f32(t.split_is_cat),
                 as_f32(t.cat_bitset.reshape(-1))])
-        self._pack_tree_fn = _pack_tree
+        if jax.process_count() > 1 and self.mesh is not None:
+            # multi-process SPMD: GSPMD may assign the packed buffer a
+            # sharding spanning other processes' devices, which the host
+            # cannot fetch; pin it fully-replicated so every rank reads
+            # its local copy (the reference's workers likewise each hold
+            # the whole model after SyncUpGlobalBestSplit)
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._pack_tree_fn = jax.jit(
+                _pack_tree,
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()))
+        else:
+            self._pack_tree_fn = _pack_tree
         from ..ops.split import cat_bitset_words
         self._cat_words = cat_bitset_words(max_b)
         # hot-path helpers kept inside jit (eager device ops are ~100ms
@@ -1018,7 +1044,7 @@ class GBDT:
         """Device TreeArrays -> host Tree (pure conversion; one batched D2H
         transfer of the whole tree as a flat buffer, like CUDATree::ToHost,
         ref: src/io/cuda/cuda_tree.cpp)."""
-        return self._packed_to_tree(np.asarray(self._pack_tree_fn(arrays)))
+        return self._packed_to_tree(_fetch_host(self._pack_tree_fn(arrays)))
 
     def _packed_to_tree(self, flat: np.ndarray) -> Optional[Tree]:
         """Decode the packed flat tree buffer into a host Tree."""
@@ -1206,7 +1232,7 @@ class GBDT:
         keep_depth remain in flight."""
         while len(self._pending) > keep_depth:
             p = self._pending.pop(0)
-            tree = self._packed_to_tree(np.asarray(p["packed"]))
+            tree = self._packed_to_tree(_fetch_host(p["packed"]))
             if tree is None:
                 # grew no split: keep a 0-value stump for this class (ref:
                 # gbdt.cpp:372-391) and record it for the stop condition

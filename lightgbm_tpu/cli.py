@@ -10,6 +10,7 @@ refit, save_binary, convert_model (ref: config.h TaskType).
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -73,30 +74,42 @@ def _task_train(cfg: Config, params: Dict[str, str]) -> None:
     log.info(f"Finished training; model saved to {cfg.output_model}")
 
 
-def _load_predict_matrix(cfg: Config) -> np.ndarray:
-    from .io.parser import parse_file
-    feats, _, _ = parse_file(cfg.data, has_header=cfg.header,
-                             label_column=cfg.label_column)
-    return feats
+# per-chunk memory budget for streamed file prediction (bytes of float64
+# features); tests shrink it to force multi-chunk runs
+_PREDICT_CHUNK_BUDGET = 32 << 20
 
 
 def _task_predict(cfg: Config, params: Dict[str, str]) -> None:
+    """Bounded-memory file prediction: the input streams through
+    parse_file_stream in row chunks (ref: predictor.hpp:30
+    PipelineReader — the reference double-buffers file chunks the same
+    way), so peak RSS is one chunk + the model, independent of file
+    size."""
     if not cfg.input_model:
         log.fatal("task=predict needs input_model=<file>")
     booster = Booster(model_file=cfg.input_model)
-    X = _load_predict_matrix(cfg)
-    pred = booster.predict(
-        X, raw_score=cfg.predict_raw_score,
-        pred_leaf=cfg.predict_leaf_index,
-        pred_contrib=cfg.predict_contrib,
-        num_iteration=cfg.num_iteration_predict)
+    from .io.parser import parse_file_stream
+    nf = booster.num_feature()
+    chunk_rows = max(128, _PREDICT_CHUNK_BUDGET // max(8 * nf, 1))
+    n_done = 0
     with open(cfg.output_result, "w") as f:
-        for row in np.atleast_1d(pred):
-            if np.ndim(row) == 0:
-                f.write(f"{row:.18g}\n")
-            else:
-                f.write("\t".join(f"{v:.18g}" for v in row) + "\n")
-    log.info(f"Finished prediction; results saved to {cfg.output_result}")
+        for feats, _ in parse_file_stream(
+                cfg.data, has_header=cfg.header,
+                label_column=cfg.label_column, chunk_rows=chunk_rows,
+                num_features=nf):
+            pred = booster.predict(
+                feats, raw_score=cfg.predict_raw_score,
+                pred_leaf=cfg.predict_leaf_index,
+                pred_contrib=cfg.predict_contrib,
+                num_iteration=cfg.num_iteration_predict)
+            for row in np.atleast_1d(pred):
+                if np.ndim(row) == 0:
+                    f.write(f"{row:.18g}\n")
+                else:
+                    f.write("\t".join(f"{v:.18g}" for v in row) + "\n")
+            n_done += len(feats)
+    log.info(f"Finished prediction of {n_done} rows; results saved to "
+             f"{cfg.output_result}")
 
 
 def _task_refit(cfg: Config, params: Dict[str, str]) -> None:
@@ -133,10 +146,84 @@ def _task_convert_model(cfg: Config, params: Dict[str, str]) -> None:
     log.info(f"Converted model saved to {out}")
 
 
+def _machine_entries(cfg: Config):
+    """machines="ip1:port1,ip2:port2" or machine_list_filename (one
+    "ip port" per line) -> ordered list of "host:port" strings
+    (ref: config.h machines/machine_list_filename; network.cpp
+    Network::Init parses both the same way)."""
+    if cfg.machines:
+        return [e.strip() for e in str(cfg.machines).split(",")
+                if e.strip()]
+    if cfg.machine_list_filename:
+        entries = []
+        with open(cfg.machine_list_filename) as f:
+            for ln in f:
+                toks = ln.split()
+                if len(toks) >= 2:
+                    entries.append(f"{toks[0]}:{toks[1]}")
+        return entries
+    return []
+
+
+def _maybe_init_distributed(cfg: Config) -> None:
+    """Multi-machine SPMD launch (ref: application.cpp:100-115 machine
+    setup; the Dask launcher plays this role in the reference's Python
+    stack).  Each worker runs this same CLI with the shared `machines`
+    list and its OWN local_listen_port; the rank is the machine-list
+    entry matching this host and port (the reference's rank resolution),
+    entry 0 doubles as the jax.distributed coordinator.  After
+    initialize(), jax.devices() spans every worker and tree_learner=
+    data/feature/voting shards over the global mesh — the collectives
+    replace the reference's socket linkers."""
+    if cfg.num_machines <= 1:
+        return
+    entries = _machine_entries(cfg)
+    if not entries:
+        log.warning("num_machines > 1 without machines / "
+                    "machine_list_filename: training runs single-process "
+                    "over the local devices only")
+        return
+    if len(entries) != cfg.num_machines:
+        log.fatal(f"num_machines={cfg.num_machines} but machine list has "
+                  f"{len(entries)} entries")
+    rank_env = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+    if rank_env is not None:
+        rank = int(rank_env)
+    else:
+        import socket
+        local_names = {"localhost", "127.0.0.1", socket.gethostname()}
+        try:
+            local_names.update(
+                socket.gethostbyname_ex(socket.gethostname())[2])
+        except OSError:
+            pass
+        rank = -1
+        for i, e in enumerate(entries):
+            host, sep, port = e.rpartition(":")
+            if not sep or not port.isdigit():
+                log.fatal(f"Malformed machines entry {e!r}; expected "
+                          "host:port")
+            if host in local_names and int(port) == cfg.local_listen_port:
+                rank = i
+                break
+        if rank < 0:
+            log.fatal("This machine (with local_listen_port="
+                      f"{cfg.local_listen_port}) is not in the machine "
+                      "list; set machines to include host:port for every "
+                      "worker")
+    import jax
+    jax.distributed.initialize(coordinator_address=entries[0],
+                               num_processes=len(entries), process_id=rank)
+    log.info(f"Joined distributed cluster as rank {rank}/{len(entries)} "
+             f"(coordinator {entries[0]}); global devices: "
+             f"{jax.device_count()}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     params = parse_args(argv)
     cfg = Config(dict(params))
+    _maybe_init_distributed(cfg)
     task = cfg.task
     handlers = {"train": _task_train, "predict": _task_predict,
                 "prediction": _task_predict, "refit": _task_refit,

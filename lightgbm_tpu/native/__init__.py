@@ -236,9 +236,10 @@ def parse_dense_native(data: bytes, delim: str, n_rows: int,
     return out[:got]
 
 
-def parse_libsvm_native(data: bytes):
+def parse_libsvm_native(data: bytes, line_offset: int = 0):
     """LibSVM text -> (features [n, max_idx+1] float64, labels [n]), or
-    None when the native parser is unavailable."""
+    None when the native parser is unavailable.  line_offset shifts
+    error line numbers for chunked (streamed) inputs."""
     lib = parser_lib()
     if lib is None:
         return None
@@ -249,7 +250,8 @@ def parse_libsvm_native(data: bytes):
     labels = np.empty(n, np.float64)
     got = lib.lgbt_parse_libsvm(data, len(data), n, n_cols, labels, feats)
     if got < 0:
-        raise ValueError(f"malformed libsvm pair on data line {-got}")
+        raise ValueError("malformed libsvm pair on data line "
+                         f"{line_offset - got}")
     return feats[:got], labels[:got]
 
 

@@ -114,12 +114,23 @@ def test_snapshot_freq(tmp_path):
 
 def test_parallel_learning_example_conf(tmp_path, monkeypatch):
     """The reference's parallel_learning config (tree_learner=feature +
-    machine list params) parses and trains; on the virtual mesh the
-    feature axis is sharded (SURVEY §2.3 #2)."""
+    machine list params).  machines is no longer a silent no-op: a host
+    that is not in the machine list fails LOUDLY (the reference's
+    Network::Init would likewise fail to bind its listed port), while
+    the single-machine form of the same config trains with the feature
+    axis sharded over the local mesh (SURVEY §2.3 #2)."""
+    import pytest as _pytest
+
+    from lightgbm_tpu.utils.log import LightGBMError
     ex = f"{EXAMPLES}/parallel_learning"
     monkeypatch.chdir(ex)      # relative data paths resolve like the ref CLI
     model = tmp_path / "model.txt"
-    rc = main(["config=train.conf", "num_iterations=2",
+    # this host is not one of mlist.txt's machines -> loud failure
+    with _pytest.raises(LightGBMError, match="machine list"):
+        main(["config=train.conf", "num_iterations=2",
+              f"output_model={model}", "verbosity=-1"])
+    # the same config minus the cluster params trains locally
+    rc = main(["config=train.conf", "num_iterations=2", "num_machines=1",
                f"output_model={model}", "verbosity=-1"])
     assert rc == 0 and model.exists()
 
@@ -145,3 +156,56 @@ def test_cli_runs_every_reference_example(example, tmp_path, monkeypatch):
     assert rc == 0
     preds = np.loadtxt(pred_out)
     assert np.isfinite(preds).all() and len(preds) > 0
+
+
+def test_cli_predict_streams_chunks(tmp_path, monkeypatch):
+    """File prediction must run in bounded row chunks (ref:
+    predictor.hpp:30 PipelineReader) and produce byte-identical output
+    to a single-chunk run."""
+    import lightgbm_tpu.cli as cli
+    model = tmp_path / "m.txt"
+    rc = main(["task=train", "objective=binary",
+               f"data={BINARY}/binary.train", f"output_model={model}",
+               "num_trees=5", "verbosity=-1"])
+    assert rc == 0
+    out_full = tmp_path / "pred_full.txt"
+    rc = main(["task=predict", f"data={BINARY}/binary.test",
+               f"input_model={model}", f"output_result={out_full}"])
+    assert rc == 0
+    # force many small chunks and compare byte-for-byte
+    monkeypatch.setattr(cli, "_PREDICT_CHUNK_BUDGET", 8 * 28 * 100)
+    out_chunked = tmp_path / "pred_chunked.txt"
+    rc = main(["task=predict", f"data={BINARY}/binary.test",
+               f"input_model={model}", f"output_result={out_chunked}"])
+    assert rc == 0
+    assert out_full.read_text() == out_chunked.read_text()
+    assert len(out_full.read_text().splitlines()) == 500
+
+
+def test_parse_file_stream_matches_parse_file(tmp_path):
+    """The streamed parser must produce the same rows as the one-shot
+    parser for dense and libsvm inputs, across chunk boundaries."""
+    import numpy as np
+    from lightgbm_tpu.io.parser import parse_file, parse_file_stream
+    dense = f"{BINARY}/binary.train"
+    f_full, l_full, _ = parse_file(dense)
+    chunks = list(parse_file_stream(dense, chunk_rows=777))
+    f_s = np.concatenate([c[0] for c in chunks])
+    l_s = np.concatenate([c[1] for c in chunks])
+    np.testing.assert_array_equal(f_full, f_s)
+    np.testing.assert_array_equal(l_full, l_s)
+    assert len(chunks) > 1
+    # libsvm with a width hint covering indices missing from late chunks
+    svm = tmp_path / "t.svm"
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(500):
+        k = rng.randint(0, 9)
+        lines.append(f"{i % 2} {k}:{rng.rand():.6f}" +
+                     (" 9:1.5" if i < 100 else ""))
+    svm.write_text("\n".join(lines) + "\n")
+    f_full, l_full, _ = parse_file(str(svm))
+    chunks = list(parse_file_stream(str(svm), chunk_rows=150,
+                                    num_features=f_full.shape[1]))
+    f_s = np.concatenate([c[0] for c in chunks])
+    np.testing.assert_array_equal(f_full, f_s)

@@ -1,0 +1,67 @@
+"""Multi-chip collective accounting regression gate.
+
+The data-parallel wave engine's only cross-device traffic should be the
+per-wave histogram psum of the COMPUTED (smaller-child) slots plus a few
+scalar reductions (ref: data_parallel_tree_learner.cpp:284
+ReduceScatter traffic model).  This test compiles the tree builder over
+the 8-device virtual mesh and pins the all-reduce count and byte volume
+so a change that starts reducing full-slot histograms (or sneaks a new
+collective into the wave loop) fails loudly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lightgbm_tpu.learner import FeatureMeta, GrowParams, grow_tree_wave
+from lightgbm_tpu.ops.split import SplitParams
+from tools.collective_accounting import all_reduce_stats
+
+N = 1 << 13
+F = 8
+B = 64
+L = 31
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_wave_allreduce_count_and_volume():
+    rng = np.random.RandomState(0)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("row",))
+    shard = NamedSharding(mesh, P(None, "row"))
+    repl = NamedSharding(mesh, P())
+    rowsh = NamedSharding(mesh, P("row"))
+    binned = jax.device_put(
+        rng.randint(0, B, size=(F, N)).astype(np.uint8), shard)
+    grad = jax.device_put(rng.randn(N).astype(np.float32), rowsh)
+    hess = jax.device_put(np.abs(rng.rand(N).astype(np.float32)) + 0.1,
+                          rowsh)
+    mask = jax.device_put(np.ones(N, np.float32), rowsh)
+    cmask = jax.device_put(np.ones(F, bool), repl)
+    meta = FeatureMeta(
+        num_bin=jax.device_put(np.full(F, B, np.int32), repl),
+        missing_type=jax.device_put(np.zeros(F, np.int32), repl),
+        default_bin=jax.device_put(np.zeros(F, np.int32), repl),
+        penalty=jax.device_put(np.ones(F, np.float32), repl))
+    gp = GrowParams(num_leaves=L, max_bin=B, hist_method="segment",
+                    split=SplitParams(min_data_in_leaf=20))
+    hlo = jax.jit(grow_tree_wave, static_argnames=("params",)).lower(
+        binned, grad, hess, mask, cmask, meta, gp).compile().as_text()
+    n_ar, bytes_ar = all_reduce_stats(hlo)
+
+    # expected psum volume: one [Kb, F, B, 2] histogram (+ [Kb] counts)
+    # per wave — Kb is the subtraction engine's computed-slot ladder —
+    # plus one [Kb, F, B, 2]-shaped reduction for the while-loop wave and
+    # small scalar reductions (root sums, final count matmul)
+    from lightgbm_tpu.ops.histogram import wave_slot_pad
+    import math
+    num_waves = max(1, math.ceil(math.log2(L)))
+    kbs = [wave_slot_pad(min(1 << max(k - 1, 0), L))
+           for k in range(num_waves)] + [wave_slot_pad(max(L // 2, 1))]
+    hist_bytes = sum(k * F * B * 2 * 4 for k in kbs)
+    assert bytes_ar >= hist_bytes, (bytes_ar, hist_bytes)
+    # regression bound: within 2x of the pure-histogram volume (scalar
+    # side reductions are small) and a fixed op-count envelope
+    assert bytes_ar <= 2 * hist_bytes, (bytes_ar, hist_bytes)
+    assert n_ar <= 10, n_ar

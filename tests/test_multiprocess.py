@@ -98,3 +98,64 @@ def test_two_process_training_identical_models(tmp_path):
         return re.sub(r"-?\d+\.\d+(e[-+]?\d+)?", "F", txt)
 
     assert structure(texts[0]) == structure(serial)
+
+
+_CLI_WORKER = r"""
+import os, sys
+rank = sys.argv[1]
+port = sys.argv[2]
+ports = sys.argv[3]
+model_out = sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["LIGHTGBM_TPU_MACHINE_RANK"] = rank
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo")
+from lightgbm_tpu.cli import main
+rc = main([
+    "task=train", "objective=regression", "tree_learner=data",
+    "data=/root/reference/examples/regression/regression.train",
+    "num_trees=3", "num_leaves=15", "verbosity=-1",
+    "tpu_growth_strategy=leafwise", "num_machines=2",
+    f"machines={ports}", f"local_listen_port={port}",
+    f"output_model={model_out}",
+])
+assert rc == 0
+print(f"cli rank {rank} done", flush=True)
+"""
+
+
+@pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
+                    reason="multiproc disabled")
+def test_cli_machines_two_workers_identical_models(tmp_path):
+    """The CLI's machines=/local_listen_port launch (ref:
+    application.cpp:100-115): two worker processes join one
+    jax.distributed cluster, train tree_learner=data over the global
+    mesh, and write identical model files."""
+    import socket
+    script = tmp_path / "cli_worker.py"
+    script.write_text(_CLI_WORKER)
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("localhost", 0))
+        s2.bind(("localhost", 0))
+        p1, p2 = (str(s1.getsockname()[1]), str(s2.getsockname()[1]))
+    machines = f"localhost:{p1},localhost:{p2}"
+    outs = [tmp_path / f"cli_model_{i}.txt" for i in range(2)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), (p1, p2)[i], machines,
+         str(outs[i])],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo") for i in range(2)]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        logs.append(out)
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    # identical models; only the parameters dump may differ (each worker
+    # records its own local_listen_port / output_model)
+    texts = [o.read_text().split("parameters:")[0] for o in outs]
+    assert texts[0] == texts[1]
+    assert "Tree=2" in texts[0]
