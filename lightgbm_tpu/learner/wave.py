@@ -118,6 +118,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     binned, kslot, ghm, max_bin=hist_B,
                     num_slots=num_slots, quant_bins=params.quant_bins,
                     quant_scales=quant_scales)
+            # Rt stays 512: 1024 is ~3% faster on small slot counts but
+            # exceeds the 16 MB scoped-VMEM limit at 128 computed slots
             return build_histogram_wave(binned, kslot, ghm,
                                         max_bin=hist_B, num_slots=num_slots)
         return _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
