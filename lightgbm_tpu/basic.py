@@ -798,19 +798,27 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         from .io.sparse import is_scipy_sparse
-        if is_scipy_sparse(data) and data.shape[0] > 1:
+        if is_scipy_sparse(data):
             # bounded-memory sparse prediction: densify row CHUNKS only
             # (~64 MB each), never the whole matrix (ref: the CSR
-            # predictor paths of c_api.cpp predict row-wise too)
+            # predictor paths of c_api.cpp predict row-wise too).  With
+            # pred_contrib the result stays sparse (the reference Python
+            # package returns scipy CSR for sparse input): each chunk's
+            # dense [chunk, (F+1)*num_class] block is converted to CSR
+            # immediately so peak memory is one chunk's block.
+            from scipy import sparse as sps
             csr = data.tocsr()
             chunk = max(1, (64 << 20) // max(8 * data.shape[1], 1))
-            parts = [
-                self.predict(csr[i:i + chunk].toarray(),
-                             start_iteration=start_iteration,
-                             num_iteration=num_iteration,
-                             raw_score=raw_score, pred_leaf=pred_leaf,
-                             pred_contrib=pred_contrib, **kwargs)
-                for i in range(0, data.shape[0], chunk)]
+            parts = []
+            for i in range(0, data.shape[0], chunk):
+                p = self.predict(csr[i:i + chunk].toarray(),
+                                 start_iteration=start_iteration,
+                                 num_iteration=num_iteration,
+                                 raw_score=raw_score, pred_leaf=pred_leaf,
+                                 pred_contrib=pred_contrib, **kwargs)
+                parts.append(sps.csr_matrix(p) if pred_contrib else p)
+            if pred_contrib:
+                return sps.vstack(parts, format="csr")
             return np.concatenate(parts, axis=0)
         data = _coerce_matrix(data)
         if num_iteration is None:

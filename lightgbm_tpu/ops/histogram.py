@@ -323,7 +323,10 @@ def build_histogram_wave(binned_fm: jnp.ndarray, slot: jnp.ndarray,
     # to a multiple of 8 (12.5% wasted one-hot volume and MXU rows at the
     # bench's 28 features) and cuts grid-cell overheads.
     unit = Bg * (S * C * NLg * 4 + row_tile * 2)
-    if F * unit <= (24 << 20):
+    # gate at the measured 16 MB scoped-VMEM limit (wave.py's documented
+    # Mosaic bound) — shapes in the 16-24 MB window compile on CPU tests
+    # but can fail Mosaic on device; fall back to the grouped path there
+    if F * unit <= (16 << 20):
         Fp = Fg = F
     else:
         Fp = (F + 7) // 8 * 8

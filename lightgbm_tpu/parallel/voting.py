@@ -100,20 +100,16 @@ def voting_hist_elect(binned, gh, member_mask, col_mask, parent_output,
         # local proposal: this worker's top-k features
         kth = jax.lax.top_k(weighted, k)[0][-1]
         prop = jnp.where(weighted >= kth, weighted, K_MIN_SCORE)
-        # global election by MAJORITY VOTE (ref: GlobalVoting tallies how
-        # many workers proposed each feature,
-        # voting_parallel_tree_learner.cpp:151): psum the 0/1 proposal
-        # vector and elect by (vote count, max weighted gain) so a single
-        # outlier-large local gain cannot displace features proposed by
-        # every worker
-        votes = jax.lax.psum((prop > K_MIN_SCORE).astype(jnp.int32), axis)
+        # global election by per-feature MAX weighted gain, exactly the
+        # reference's GlobalVoting (voting_parallel_tree_learner.cpp:
+        # 151-180): it concatenates every worker's proposals and keeps the
+        # top-k features by the largest weighted gain any worker reported
+        # (ArrayArgs::MaxK) — it never tallies votes.  pmax of the masked
+        # proposal vectors gives each feature its max proposed gain;
+        # non-proposed features stay at K_MIN_SCORE.
         glob = jax.lax.pmax(prop, axis)
-        F_ = glob.shape[0]
-        gain_rank = jnp.zeros(F_, jnp.int32).at[
-            jnp.argsort(glob)].set(jnp.arange(F_, dtype=jnp.int32))
-        key = votes * F_ + jnp.where(votes > 0, gain_rank, 0)
-        top_v, top_i = jax.lax.top_k(key, k)
-        valid = top_v > 0
+        top_v, top_i = jax.lax.top_k(glob, k)
+        valid = top_v > K_MIN_SCORE
         # reduce ONLY the elected features' histograms
         sub = jax.lax.psum(hist_l[top_i], axis)             # [k, B, 2]
         F = hist_l.shape[0]

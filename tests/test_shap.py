@@ -113,3 +113,19 @@ def test_native_lib_compiles():
     from lightgbm_tpu.native import treeshap_lib
     assert treeshap_lib() is not None, \
         "native TreeSHAP failed to compile (cc available in the image)"
+
+
+def test_contrib_sparse_input_returns_sparse():
+    """pred_contrib on scipy-sparse input returns a scipy CSR matrix that
+    matches the dense result (ref: python-package basic.py predict returns
+    sparse contribs for sparse input)."""
+    from scipy import sparse as sps
+    X, y = _problem()
+    Xs = np.where(np.abs(X) > 0.8, X, 0.0)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1, "min_data_in_leaf": 5},
+                        lgb.Dataset(Xs, label=y), num_boost_round=5)
+    dense = booster.predict(Xs[:64], pred_contrib=True)
+    out = booster.predict(sps.csr_matrix(Xs[:64]), pred_contrib=True)
+    assert sps.issparse(out)
+    np.testing.assert_allclose(out.toarray(), dense, rtol=1e-6, atol=1e-9)
