@@ -798,7 +798,7 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         from .io.sparse import is_scipy_sparse
-        if is_scipy_sparse(data):
+        if is_scipy_sparse(data) and data.shape[0] > 0:
             # bounded-memory sparse prediction: densify row CHUNKS only
             # (~64 MB each), never the whole matrix (ref: the CSR
             # predictor paths of c_api.cpp predict row-wise too).  With

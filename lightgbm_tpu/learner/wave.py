@@ -47,7 +47,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram_wave, wave_slot_pad
+from ..ops.histogram import (build_histogram_wave, build_histogram_wave_hl,
+                             hl_split_of, wave_hl_profitable, wave_slot_pad)
 from ..ops.split import K_MIN_SCORE, cat_bitset_words, find_best_split
 from .grow import (FeatureMeta, GrowParams, TreeArrays,
                    bundle_hist_to_features)
@@ -105,10 +106,29 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     use_int8 = (use_pallas and params.quant_bins > 0
                 and quant_scales is not None)
 
-    def hists_of(kslot, ghm, num_slots):
+    binned_rm = None
+    if use_pallas and not use_int8:
+        # row-major copy for the decomposed small-S kernel's lo side
+        # (transposed once per tree; bins are static so XLA keeps it
+        # resident for all waves of the tree)
+        binned_rm = binned.T
+
+    def _hl_fits(true_slots):
+        """VMEM gate for the decomposed kernel (no feature grouping)."""
+        F_, Rt, C_ = binned.shape[0], 512, 2
+        Bh, Bl = hl_split_of(hist_B, true_slots, C_)
+        Wd = F_ * Bl * C_ * true_slots
+        vmem = (F_ * Bh * Rt * 2 + Rt * Wd * 10 + F_ * Bh * Bl
+                * C_ * true_slots * 4)
+        return vmem <= (12 << 20)
+
+    def hists_of(kslot, ghm, num_slots, true_slots=None):
         """Group-space histograms for the COMPUTED (compact) slots only;
         rows outside computed leaves carry zeroed gh channels.  The full
-        per-leaf set is completed by sibling subtraction at the cache."""
+        per-leaf set is completed by sibling subtraction at the cache.
+        `true_slots` (<= num_slots) is the unpadded computed-slot bound:
+        when it is small the decomposed hi/lo kernel streams far less
+        VMEM volume (ops/histogram.py _wave_kernel_hl)."""
         if use_pallas:
             if use_int8:
                 # quantized grid grads -> exact int32 accumulation through
@@ -118,6 +138,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     binned, kslot, ghm, max_bin=hist_B,
                     num_slots=num_slots, quant_bins=params.quant_bins,
                     quant_scales=quant_scales)
+            if (true_slots is not None and binned_rm is not None
+                    and wave_hl_profitable(hist_B, true_slots)
+                    and _hl_fits(true_slots)):
+                return build_histogram_wave_hl(
+                    binned, binned_rm, kslot, ghm, max_bin=hist_B,
+                    num_slots=true_slots, out_slots=num_slots)
             # Rt stays 512: 1024 is ~3% faster on small slot counts but
             # exceeds the 16 MB scoped-VMEM limit at 128 computed slots
             return build_histogram_wave(binned, kslot, ghm,
@@ -258,7 +284,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     pend_sl0 = jnp.zeros(Lp, bool)
 
     def wave_hists(kslot, cache_h, cache_c,
-                   pend_sel, pend_new, pend_rank, pend_sl, Kb, first):
+                   pend_sel, pend_new, pend_rank, pend_sl, Kb, first,
+                   Ks=None):
         """Update the per-leaf histogram cache for the leaves created by
         the previous wave: ONE fused kernel pass computes the SMALLER
         child of each pending split (compact slot = pair rank), the larger
@@ -270,7 +297,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         computed leaf carry the out-of-range sentinel Lp, which matches no
         slot one-hot bucket — no per-row gather or gh masking needed
         here)."""
-        H, cnt = hists_of(kslot, gh, Kb)               # [Kb, F', B', 2]
+        H, cnt = hists_of(kslot, gh, Kb, Ks)           # [Kb, F', B', 2]
         cnt = cnt.astype(f32)
         if first:
             # root wave: kslot is all zeros; one computed slot
@@ -317,9 +344,11 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cache_c = cache_c * keep + jnp.sum(W * child_c[None, :], axis=1)
         return cache_h, cache_c
 
-    def wave_body(state, NLp, Kb, first=False):
+    def wave_body(state, NLp, Kb, first=False, Ks=None):
         """One wave with a static slot bound NLp >= current num_leaves and
-        a static computed-slot bound Kb >= splits of the previous wave."""
+        a static computed-slot bound Kb >= splits of the previous wave.
+        Ks is the TRUE (unpadded) computed-slot bound for the decomposed
+        small-S histogram kernel."""
         (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
          leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
          pend_sel, pend_new, pend_rank, pend_sl, _) = state
@@ -331,7 +360,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         #    the count cache)
         cache_h, cache_c = wave_hists(kslot, cache_h, cache_c, pend_sel,
                                       pend_new, pend_rank, pend_sl, Kb,
-                                      first)
+                                      first, Ks)
         hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
         counts = jnp.round(cache_c[:NLp]).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
@@ -573,10 +602,11 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         NLp = wave_slot_pad(min(1 << k, L))
         # computed slots this wave = splits of the previous wave, bounded
         # by the previous wave's leaf count (root wave computes 1 slot)
-        Kb = wave_slot_pad(min(1 << max(k - 1, 0), L))
+        Ks = min(1 << max(k - 1, 0), L)
+        Kb = wave_slot_pad(Ks)
         state = jax.lax.cond(state[-1],
                              functools.partial(wave_body, NLp=NLp, Kb=Kb,
-                                               first=(k == 0)),
+                                               first=(k == 0), Ks=Ks),
                              lambda s: s, state)
     if num_waves > 0:
         # growth slower than doubling (chain-shaped gain landscapes) needs

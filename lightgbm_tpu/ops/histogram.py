@@ -234,6 +234,166 @@ def _wave_kernel(C: int, Fg: int, Bg: int, NLg: int):
     return kernel
 
 
+def _wave_kernel_hl(C: int, Fg: int, Bh: int, Bl: int, S: int, P: int):
+    """Decomposed (hi/lo outer-product) wave kernel for FEW computed slots.
+
+    The flat-floor cost of `_wave_kernel` is the F*B*Rt bin one-hot built
+    in VMEM every wave.  For waves whose computed-slot count S is small,
+    the one-hot factors over a hi/lo split of the bin code
+
+        onehot_B(bin) = onehot_Bh(bin >> log2(Bl)) (x) onehot_Bl(bin & Bl-1)
+
+        hist[f, bh, bl, (c,s)] = sum_n 1[hi=bh] * (1[lo=bl] * w[n,(c,s)])
+
+    so the materialized volume drops from F*B*Rt to
+    F*(Bh + Bl*C*S)*Rt — e.g. 48 vs 256 lane-units per feature per row at
+    S=1.  Measured on the v5e chip this is ~1.5x the full kernel at S<=2
+    and ~1.25x at S=4 (tools/profile_hl.py); the advantage vanishes by
+    S=16, where `_wave_kernel`'s slot-riding RHS is already optimal.
+
+    The RHS is built at FULL 128-lane width with expander matmuls —
+    sub-128-lane elementwise ops pad to whole vregs on TPU, so a naive
+    per-feature [Rt, C*S] build would pay full-width cost anyway:
+
+        d  = [lo_rm | 1] @ [E ; -bl_pat]   (lo minus the column's target
+                                            bl; zero exactly on match)
+        wt = w_sc @ T                      (tile CS channels across cols)
+        sc = where(d == 0, wt, 0)
+
+    Main dots pack P features into M and P column blocks into N; only the
+    diagonal (f, f) blocks of each [P*Bh, P*Bl*C*S] product are kept.
+    (Counterpart of the same smaller-child histogramming the reference
+    does serially, dense_bin.hpp:99-176; decomposition is TPU-only.)"""
+    CS = C * S
+    Wd = Fg * Bl * CS
+    shift = Bl.bit_length() - 1
+
+    def kernel(rows_ref, rows_rm_ref, slot_ref, gh_ref, out_ref, cnt_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        i32, bf16 = jnp.int32, jnp.bfloat16
+        rows = rows_ref[...].astype(i32)          # [Fg, Rt] (lanes=Rt)
+        Rt = rows.shape[1]
+        rows_rm = rows_rm_ref[...].astype(i32)    # [Rt, Fg] (sublanes=Rt)
+        slot = slot_ref[...].astype(i32)          # [Rt, 1]
+        gh = gh_ref[...]                          # [Rt, C+1]
+
+        hi = rows >> shift
+        biota = jax.lax.broadcasted_iota(i32, (Fg, Bh, Rt), 1)
+        hi_oh = (hi[:, None, :] == biota).astype(bf16)
+
+        # w_sc [Rt, C*S]: slot one-hot x channels (c-major)
+        soh = (slot == jax.lax.broadcasted_iota(i32, (Rt, S), 1))
+        sohb = soh.astype(bf16)
+        w_sc = jnp.concatenate(
+            [sohb * gh[:, c:c + 1].astype(bf16) for c in range(C)], axis=1)
+
+        lo = (rows_rm & (Bl - 1)).astype(bf16)    # [Rt, Fg]
+        ones = jnp.ones((Rt, 1), bf16)
+        lhs2 = jnp.concatenate([lo, ones], axis=1)            # [Rt, Fg+1]
+        colf = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // (Bl * CS)
+        rowi = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 0)
+        blp = (jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // CS) % Bl
+        E2 = jnp.where(rowi == Fg, (-blp).astype(bf16),
+                       (colf == rowi).astype(bf16))           # [Fg+1, Wd]
+        d = jax.lax.dot_general(lhs2, E2, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        csp = jax.lax.broadcasted_iota(i32, (CS, Wd), 1)
+        Tm = (csp % CS ==
+              jax.lax.broadcasted_iota(i32, (CS, Wd), 0)).astype(bf16)
+        wt = jax.lax.dot_general(w_sc, Tm, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        sc = jnp.where(d == 0.0, wt, 0.0).astype(bf16)        # [Rt, Wd]
+
+        BCS = Bl * CS
+        for f0 in range(0, Fg, P):
+            lhs = hi_oh[f0:f0 + P].reshape(P * Bh, Rt)
+            rhs = sc[:, f0 * BCS:(f0 + P) * BCS]
+            acc = jax.lax.dot_general(lhs, rhs, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            for p in range(P):
+                out_ref[f0 + p] += acc[p * Bh:(p + 1) * Bh,
+                                       p * BCS:(p + 1) * BCS]
+        # ride-along exact counts (mask column against the slot one-hot)
+        mask8 = jnp.broadcast_to(gh[:, C:C + 1].astype(bf16), (Rt, 8)).T
+        cacc = jax.lax.dot_general(mask8, sohb, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        cnt_ref[...] += cacc
+    return kernel
+
+
+def hl_split_of(max_bin: int, num_slots: int, C: int):
+    """(Bh, Bl) split for the decomposed kernel, tuned on the chip
+    (tools/profile_hl.py): balance Bh against Bl*C*S."""
+    CS = C * num_slots
+    best = None
+    for Bl in (2, 4, 8, 16, 32):
+        Bh = -(-max_bin // Bl)
+        Bh8 = max(8, -(-Bh // 8) * 8)
+        cost = Bh8 + Bl * CS
+        if best is None or cost < best[0]:
+            best = (cost, Bh8, Bl)
+    return best[1], best[2]
+
+
+def wave_hl_profitable(max_bin: int, num_slots: int, C: int = 2) -> bool:
+    """True when the decomposed kernel's materialized volume is
+    meaningfully below the full kernel's F*B (measured crossover ~0.6)."""
+    Bh, Bl = hl_split_of(max_bin, num_slots, C)
+    # Bh > 256 would overflow the feature-packed M dimension (and such
+    # giant max_bin configs gain nothing from decomposition anyway)
+    return Bh <= 256 and (Bh + Bl * C * num_slots) <= 0.6 * max_bin
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "num_slots", "out_slots",
+                                    "row_tile"))
+def build_histogram_wave_hl(binned_fm: jnp.ndarray, binned_rm: jnp.ndarray,
+                            slot: jnp.ndarray, gh: jnp.ndarray, *,
+                            max_bin: int, num_slots: int, out_slots: int,
+                            row_tile: int = 512):
+    """Decomposed-kernel variant of `build_histogram_wave` for waves with
+    few computed slots (see `_wave_kernel_hl`).  `num_slots` is the TRUE
+    computed-slot bound; the output is zero-padded to `out_slots` rows so
+    callers keep the padded-Kb contract.  Returns
+    (hist [out_slots, F, B, C] float32, counts [out_slots] float32)."""
+    F, n = binned_fm.shape
+    C = gh.shape[-1] - 1
+    S = num_slots
+    Bh, Bl = hl_split_of(max_bin, S, C)
+    P = next((p for p in (4, 2, 1) if F % p == 0 and p * Bh <= 256), 1)
+    if n % row_tile != 0:
+        raise ValueError(f"n {n} not a multiple of row_tile {row_tile}")
+    out, cnt = pl.pallas_call(
+        _wave_kernel_hl(C, F, Bh, Bl, S, P),
+        grid=(n // row_tile,),
+        in_specs=[
+            pl.BlockSpec((F, row_tile), lambda i: (0, i)),
+            pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, C + 1), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((F, Bh, Bl * C * S), lambda i: (0, 0, 0)),
+            pl.BlockSpec((8, S), lambda i: (0, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((F, Bh, Bl * C * S), jnp.float32),
+            jax.ShapeDtypeStruct((8, S), jnp.float32)],
+    )(binned_fm, binned_rm, slot.reshape(n, 1), gh)
+    # [F, Bh, (bl, c, s)] -> [S, F, B, C], zero-padded to out_slots
+    h = out.reshape(F, Bh, Bl, C, S).transpose(4, 0, 1, 2, 3)
+    h = h.reshape(S, F, Bh * Bl, C)[:, :, :max_bin, :]
+    pad = out_slots - S
+    if pad > 0:
+        h = jnp.concatenate(
+            [h, jnp.zeros((pad,) + h.shape[1:], h.dtype)], axis=0)
+        cntv = jnp.concatenate([cnt[0], jnp.zeros(pad, cnt.dtype)])
+    else:
+        cntv = cnt[0]
+    return h, cntv
+
+
 def _pick_feature_group(Fp: int, unit_bytes: int, budget: int) -> int:
     """Largest 8-multiple divisor of Fp whose VMEM cost Fg*unit_bytes fits
     the budget (TPU blocks need 8-aligned sublane dims; 8 is the floor)."""

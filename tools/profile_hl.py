@@ -1,0 +1,211 @@
+"""Probe: hi/lo outer-product decomposition of the wave histogram kernel.
+
+The wave kernel's floor is the F*B*Rt bin one-hot built in VMEM every wave
+(PERF_NOTES.md).  For waves with FEW computed slots S the one-hot factors:
+
+  onehot_B(bin) = onehot_Bh(bin >> log2(Bl))  (x)  onehot_Bl(bin & (Bl-1))
+
+  hist[f, bh, bl, (c,s)] = sum_n 1[hi=bh] * (1[lo=bl] * w[n, (c,s)])
+
+LHS volume F*Bh*Rt, RHS volume F*Bl*C*S*Rt — for small S both are far
+below F*B*Rt (e.g. S=1: 48 vs 256 lane-units per feature per row).
+
+The RHS is built at FULL 128-lane efficiency with expander matmuls
+(sub-128-lane elementwise ops pad to full vregs on TPU, so a naive per-f
+[Rt, C*S] build would pay full-width cost):
+
+  d  = [lo_rm | 1] @ [E ; -bl_pat]   (one matmul: lo value minus the
+                                      column's bl target; 0 where matched)
+  wt = w_sc @ T                      (CS -> F*Bl*CS column tiling)
+  sc = where(d == 0, wt, 0)
+
+Main dots pack P features into M (P*Bh <= 256) and P column blocks into N.
+
+Usage: python tools/profile_hl.py   (on the TPU chip)
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+N = 1 << 20
+F = 28
+B = 256
+C = 2
+Rt = 512
+REPS = 10
+
+rng = np.random.RandomState(0)
+binned_np = rng.randint(0, B, size=(F, N), dtype=np.uint8)
+
+
+def timeit(name, fn, *args):
+    # NOTE: through the axon tunnel block_until_ready can return early;
+    # a host transfer (float()) is the only reliable completion barrier.
+    # Inputs are perturbed per scan step so XLA cannot hoist the call.
+    @jax.jit
+    def loop(b, *rest):
+        def step(c, x):
+            r = fn(b, *rest[:-1], rest[-1].at[0, 0].add(x))
+            return c + jnp.float32(jnp.sum(r[0][..., 0])), None
+        out, _ = jax.lax.scan(step, jnp.float32(0),
+                              jnp.arange(REPS, dtype=jnp.float32))
+        return out
+    try:
+        float(loop(*args))
+    except Exception as e:
+        print(f"{name:44s} FAILED: {str(e)[:160]}", flush=True)
+        return None
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        float(loop(*args))
+        best = min(best, (time.time() - t0) / REPS)
+    print(f"{name:44s} {best*1e3:8.2f} ms", flush=True)
+    return best
+
+
+# ----------------------------------------------------------------------
+# decomposed kernel
+# ----------------------------------------------------------------------
+def _hl_kernel(Fg, Bh, Bl, S, P):
+    CS = C * S
+    Wd = Fg * Bl * CS
+    shift = Bl.bit_length() - 1
+
+    def kernel(rows_ref, rows_rm_ref, slot_ref, gh_ref, out_ref, cnt_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        i32, bf16 = jnp.int32, jnp.bfloat16
+        rows = rows_ref[...].astype(i32)          # [Fg, Rt] (lanes=Rt)
+        Rt = rows.shape[1]
+        rows_rm = rows_rm_ref[...].astype(i32)    # [Rt, Fg] (sublanes=Rt)
+        slot = slot_ref[...].astype(i32)          # [Rt, 1]
+        gh = gh_ref[...]                          # [Rt, C+1]
+
+        # LHS: hi one-hot [Fg, Bh, Rt]
+        hi = rows >> shift
+        biota = jax.lax.broadcasted_iota(i32, (Fg, Bh, Rt), 1)
+        hi_oh = (hi[:, None, :] == biota).astype(bf16)
+
+        # w_sc [Rt, CS]: slot one-hot x channels (c-major)
+        soh = (slot == jax.lax.broadcasted_iota(i32, (Rt, S), 1))
+        sohb = soh.astype(bf16)
+        w_sc = jnp.concatenate(
+            [sohb * gh[:, c:c + 1].astype(bf16) for c in range(C)], axis=1)
+
+        # RHS via expander matmuls, all at full lane width:
+        lo = (rows_rm & (Bl - 1)).astype(bf16)    # [Rt, Fg]
+        ones = jnp.ones((Rt, 1), bf16)
+        lhs2 = jnp.concatenate([lo, ones], axis=1)            # [Rt, Fg+1]
+        colf = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // (Bl * CS)
+        rowi = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 0)
+        blp = (jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // CS) % Bl
+        E2 = jnp.where(rowi == Fg, (-blp).astype(bf16),
+                       (colf == rowi).astype(bf16))           # [Fg+1, Wd]
+        d = jax.lax.dot_general(lhs2, E2, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        csp = jax.lax.broadcasted_iota(i32, (S if False else C * S, Wd), 1)
+        Tm = (csp % CS ==
+              jax.lax.broadcasted_iota(i32, (CS, Wd), 0)).astype(bf16)
+        wt = jax.lax.dot_general(w_sc, Tm, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        sc = jnp.where(d == 0.0, wt, 0.0).astype(bf16)        # [Rt, Wd]
+
+        # main dots: P features per dot
+        BCS = Bl * CS
+        for f0 in range(0, Fg, P):
+            lhs = hi_oh[f0:f0 + P].reshape(P * Bh, Rt)
+            rhs = sc[:, f0 * BCS:(f0 + P) * BCS]
+            acc = jax.lax.dot_general(lhs, rhs, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            for p in range(P):
+                out_ref[f0 + p] += acc[p * Bh:(p + 1) * Bh,
+                                       p * BCS:(p + 1) * BCS]
+        # ride-along exact counts
+        mask8 = jnp.broadcast_to(gh[:, C:C + 1].astype(bf16), (Rt, 8)).T
+        cacc = jax.lax.dot_general(mask8, sohb, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        cnt_ref[...] += cacc
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("Bh", "Bl", "S", "P"))
+def hist_hl(binned_fm, binned_rm, slot, gh, *, Bh, Bl, S, P):
+    n = binned_fm.shape[1]
+    slot = slot.reshape(n, 1)
+    out, cnt = pl.pallas_call(
+        _hl_kernel(F, Bh, Bl, S, P),
+        grid=(n // Rt,),
+        in_specs=[
+            pl.BlockSpec((F, Rt), lambda i: (0, i)),
+            pl.BlockSpec((Rt, F), lambda i: (i, 0)),
+            pl.BlockSpec((Rt, 1), lambda i: (i, 0)),
+            pl.BlockSpec((Rt, C + 1), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((F, Bh, Bl * C * S), lambda i: (0, 0, 0)),
+            pl.BlockSpec((8, S), lambda i: (0, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((F, Bh, Bl * C * S), jnp.float32),
+            jax.ShapeDtypeStruct((8, S), jnp.float32)],
+    )(binned_fm, binned_rm, slot, gh)
+    # [F, Bh, (bl, c, s)] -> [S, F, B, C]
+    h = out.reshape(F, Bh, Bl, C, S).transpose(4, 0, 1, 2, 3)
+    return h.reshape(S, F, B, C), cnt[0]
+
+
+def main():
+    from lightgbm_tpu.ops.histogram import build_histogram_wave
+
+    binned_fm = jnp.asarray(binned_np)
+    binned_rm = jnp.asarray(binned_np.T)
+    gvals = rng.randn(N, C).astype(np.float32)
+    mask = np.ones((N, 1), np.float32)
+    gh = jnp.asarray(np.concatenate([gvals, mask], axis=1))
+
+    print(f"n={N}, F={F}, B={B}, C={C}, Rt={Rt}", flush=True)
+
+    for S, Bh, Bl, P in [(1, 16, 16, 4), (2, 32, 8, 4), (4, 32, 8, 2),
+                         (8, 64, 4, 2), (16, 64, 4, 1)]:
+        slot_np = rng.randint(0, 2 * S, size=N).astype(np.int32)
+        slot_np = np.where(slot_np < S, slot_np, 999999)  # sentinels
+        slot = jnp.asarray(slot_np)
+        # correctness vs XLA reference on a small prefix
+        ns = 1 << 14
+        h, cnt = jax.jit(functools.partial(hist_hl, Bh=Bh, Bl=Bl, S=S, P=P)
+                         )(binned_fm[:, :ns][:, :Rt * (ns // Rt)],
+                           binned_rm[:ns], slot[:ns], gh[:ns])
+        oh_s = (np.asarray(slot[:ns])[:, None] == np.arange(S)[None, :])
+        oh_b = (binned_np[:, :ns][:, :, None] ==
+                np.arange(B)[None, None, :])
+        ghb = np.asarray(jnp.asarray(gh[:ns, :C]).astype(jnp.bfloat16),
+                         np.float64)  # kernel operands are bf16
+        ref = np.einsum("ns,fnb,nc->sfbc", oh_s.astype(np.float64),
+                        oh_b.astype(np.float64), ghb)
+        err = np.abs(np.asarray(h, np.float64) - ref).max()
+        refc = oh_s.sum(axis=0)
+        errc = np.abs(np.asarray(cnt, np.float64)[:S] - refc).max()
+        assert err < 1e-2 and errc == 0, (S, err, errc)
+        timeit(f"hl S={S} Bh={Bh} Bl={Bl} P={P}",
+               functools.partial(hist_hl, Bh=Bh, Bl=Bl, S=S, P=P),
+               binned_fm, binned_rm, slot, gh)
+
+    # current kernel baselines
+    for Kb in (8, 16):
+        slot = jnp.asarray(rng.randint(0, Kb, size=N).astype(np.int32))
+        timeit(f"current wave kernel Kb={Kb}",
+               functools.partial(build_histogram_wave, max_bin=B,
+                                 num_slots=Kb), binned_fm, slot, gh)
+
+
+if __name__ == "__main__":
+    main()
