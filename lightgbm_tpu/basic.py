@@ -798,6 +798,11 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         from .io.sparse import is_scipy_sparse
+        if is_scipy_sparse(data) and data.shape[0] == 0 and pred_contrib:
+            # keep the sparse-in -> sparse-out contract on the empty edge
+            from scipy import sparse as sps
+            nc = getattr(self._gbdt, "num_tree_per_iteration", 1)
+            return sps.csr_matrix((0, (data.shape[1] + 1) * nc))
         if is_scipy_sparse(data) and data.shape[0] > 0:
             # bounded-memory sparse prediction: densify row CHUNKS only
             # (~64 MB each), never the whole matrix (ref: the CSR

@@ -413,6 +413,8 @@ class GBDT:
             monotone_intermediate=self._mono_intermediate,
             monotone_advanced=self._mono_advanced,
             wave_tail_halving=config.wave_tail_halving,
+            wave_prune=config.wave_prune,
+            wave_prune_overshoot=config.wave_prune_overshoot,
             # int8 MXU histogram path for quantized training (grid must
             # fit int8; hessian ints reach num_grad_quant_bins).  The
             # int32 accumulator must hold n * max_int for a root-level

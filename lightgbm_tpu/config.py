@@ -104,6 +104,13 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # half of it per wave (best-gain-first), allocating tail leaves closer
     # to the leaf-wise order for a few extra cheap waves (PERF_NOTES.md)
     ("wave_tail_halving", "bool", False, ()),
+    # wave engine quality mode (default): overgrow past num_leaves with
+    # the cheap level-batched ladder, then prune back to num_leaves in
+    # the reference's strict leaf-wise best-gain order simulated over the
+    # overgrown tree's exact gains — recovers the leaf-wise tree exactly
+    # whenever its splits lie within the overgrown region
+    ("wave_prune", "bool", True, ()),
+    ("wave_prune_overshoot", "float", 1.5, ()),
     ("num_threads", "int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
     ("device_type", "str", "tpu", ("device",)),
     ("seed", "int", 0, ("random_seed", "random_state")),

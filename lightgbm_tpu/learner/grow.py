@@ -117,6 +117,16 @@ class GrowParams(NamedTuple):
     # few extra cheap waves).  See PERF_NOTES.md for the measured
     # wave-vs-leafwise AUC gap this addresses.
     wave_tail_halving: bool = False
+    # wave engine: overgrow the tree past num_leaves with the normal
+    # (cheap, level-batched) ladder, then PRUNE back to num_leaves by
+    # simulating the reference's strict leaf-wise best-gain pop order
+    # over the overgrown tree's exact split gains (ref:
+    # serial_tree_learner.cpp:219 ArgMax leaf order).  Recovers the
+    # leaf-wise tree exactly whenever its splits lie within the
+    # overgrown depth; incompatible with monotone/CEGB (their
+    # gains/constraints depend on realized split order).
+    wave_prune: bool = False
+    wave_prune_overshoot: float = 1.5
     # monotone_constraints_method=advanced (ref:
     # monotone_constraints.hpp:858 AdvancedLeafConstraints): per-(leaf,
     # feature, threshold) constraint surfaces derived from the leaf

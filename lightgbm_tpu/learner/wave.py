@@ -235,11 +235,19 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sum_h0 = jnp.sum(hess)
     cnt0 = jnp.sum(row_mask).astype(i32)
 
-    ni = max(L - 1, 1)
+    # overgrow-and-prune quality mode (see GrowParams.wave_prune): the
+    # ladder grows to Lg > L leaves, then the leaf-wise pop order is
+    # simulated over the overgrown gains and the tree pruned back to L
+    prune = (params.wave_prune and L > 2 and not sp.has_monotone
+             and not sp.has_cegb and not params.wave_tail_halving)
+    Lg = (min(max(L, int(math.ceil(L * params.wave_prune_overshoot))),
+              4 * L) if prune else L)
+
+    ni = max(Lg - 1, 1)
     W = cat_bitset_words(B)
-    # leaf-indexed arrays are sized to the padded slot bound (>= L) so
+    # leaf-indexed arrays are sized to the padded slot bound (>= Lg) so
     # static [:NLp] slices stay in range; sliced back to [L] on return
-    Lp = wave_slot_pad(L)
+    Lp = wave_slot_pad(Lg)
     tree = TreeArrays(
         num_leaves=jnp.asarray(1, i32),
         split_feature=jnp.zeros(ni, i32),
@@ -383,7 +391,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             gain = jnp.where(tree.leaf_depth[:NLp] < params.max_depth,
                              gain, K_MIN_SCORE)
         want = gain > 0.0
-        budget = L - NL
+        budget = Lg - NL
         if params.wave_tail_halving:
             # once the leaf budget binds, spend at most half of it per
             # wave (always best-gain-first): the tail of the tree then
@@ -586,7 +594,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         pend_new = lpz.at[:NLp].set(newleaf_of)
         pend_rank = lpz.at[:NLp].set(rank_of)
         pend_sl = jnp.zeros(Lp, bool).at[:NLp].set(small_left)
-        cont = (n_split > 0) & (tree.num_leaves < L)
+        cont = (n_split > 0) & (tree.num_leaves < Lg)
         return (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
                 leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
                 pend_sel, pend_new, pend_rank, pend_sl, cont)
@@ -597,12 +605,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              leaf_sum_h0, leaf_out0, leaf_cmin0, leaf_cmax0, cegb_used,
              cache_h0, cache_c0, pend_sel0, pend_new0, pend_rank0, pend_sl0,
              jnp.asarray(L > 1))
-    num_waves = max(1, math.ceil(math.log2(L))) if L > 1 else 0
+    num_waves = max(1, math.ceil(math.log2(Lg))) if Lg > 1 else 0
     for k in range(num_waves):
-        NLp = wave_slot_pad(min(1 << k, L))
+        NLp = wave_slot_pad(min(1 << k, Lg))
         # computed slots this wave = splits of the previous wave, bounded
         # by the previous wave's leaf count (root wave computes 1 slot)
-        Ks = min(1 << max(k - 1, 0), L)
+        Ks = min(1 << max(k - 1, 0), Lg)
         Kb = wave_slot_pad(Ks)
         state = jax.lax.cond(state[-1],
                              functools.partial(wave_body, NLp=NLp, Kb=Kb,
@@ -612,14 +620,206 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # growth slower than doubling (chain-shaped gain landscapes) needs
         # more rounds than the unrolled ladder: keep waving at the full
         # slot bound until no leaf splits or the budget is exhausted.
-        # Splits per wave <= min(NL, L - NL) <= L // 2.
+        # Splits per wave <= min(NL, Lg - NL) <= Lg // 2.
         state = jax.lax.while_loop(
             lambda s: s[-1],
-            functools.partial(wave_body, NLp=wave_slot_pad(L),
-                              Kb=wave_slot_pad(max(L // 2, 1))), state)
+            functools.partial(wave_body, NLp=wave_slot_pad(Lg),
+                              Kb=wave_slot_pad(max(Lg // 2, 1))), state)
+
+    def _prune_to_leafwise(tree, leaf_id):
+        """Prune the overgrown (<= Lg leaves) tree back to L leaves in the
+        reference's strict leaf-wise order (serial_tree_learner.cpp:219
+        ArgMax over leaf gains): simulate the best-gain pop sequence over
+        the overgrown tree's exact split gains, keep the popped splits,
+        renumber nodes/leaves by pop order (the reference's creation
+        order), and remap rows to their nearest kept ancestor's side.
+        Exactly the leaf-wise tree whenever its splits lie within the
+        overgrown region; a node's gain depends only on its row set, so
+        kept gains are identical to what leaf-wise would have computed."""
+        nodes = jnp.arange(ni, dtype=i32)
+        NN = tree.num_leaves - 1                   # realized node count
+        created = nodes < NN
+        lc, rc = tree.left_child, tree.right_child
+        # parent-of-node via child-pointer scatter
+        lci = jnp.where(created & (lc >= 0), lc, ni)
+        rci = jnp.where(created & (rc >= 0), rc, ni)
+        par = (jnp.full(ni, -1, i32).at[lci].set(nodes, mode="drop")
+               .at[rci].set(nodes, mode="drop"))
+        gains = jnp.where(created, tree.split_gain, K_MIN_SCORE)
+
+        nf = max(L - 1, 1)
+        kept0 = jnp.zeros(ni, bool)
+        avail0 = created & (par == -1)             # the root node
+        new_id0 = jnp.zeros(ni, i32)
+        pop0 = jnp.zeros(nf, i32)
+        lid_of0 = jnp.zeros(ni, i32)               # leaf id a node splits
+        dep_of0 = jnp.zeros(ni, i32)               # depth of that leaf
+        nl_l0 = jnp.zeros(ni, i32)                 # left/right child leaf
+        nl_r0 = jnp.zeros(ni, i32)                 # ids assigned at pop
+
+        def pop_step(t, st):
+            kept, avail, new_id, pop, lid_of, dep_of, nl_l, nl_r, cnt = st
+            score = jnp.where(avail & ~kept & (gains > 0.0), gains,
+                              K_MIN_SCORE)
+            j = jnp.argmax(score).astype(i32)
+            ok = score[j] > K_MIN_SCORE
+            jd = jnp.where(ok, j, ni)
+            kept = kept.at[jd].set(True, mode="drop")
+            new_id = new_id.at[jd].set(cnt, mode="drop")
+            pop = pop.at[jnp.where(ok, cnt, nf)].set(j, mode="drop")
+            ll = lid_of[j]
+            nl_l = nl_l.at[jd].set(ll, mode="drop")
+            nl_r = nl_r.at[jd].set(cnt + 1, mode="drop")
+            lcj, rcj = lc[j], rc[j]
+            dl = dep_of[j] + 1
+            lt = jnp.where(ok & (lcj >= 0), lcj, ni)
+            rt = jnp.where(ok & (rcj >= 0), rcj, ni)
+            lid_of = (lid_of.at[lt].set(ll, mode="drop")
+                      .at[rt].set(cnt + 1, mode="drop"))
+            dep_of = (dep_of.at[lt].set(dl, mode="drop")
+                      .at[rt].set(dl, mode="drop"))
+            avail = (avail.at[lt].set(True, mode="drop")
+                     .at[rt].set(True, mode="drop"))
+            return (kept, avail, new_id, pop, lid_of, dep_of, nl_l, nl_r,
+                    cnt + jnp.where(ok, 1, 0))
+
+        (kept, _, new_id, pop, lid_of, dep_of, nl_l, nl_r,
+         n_kept) = jax.lax.fori_loop(
+            0, nf, pop_step,
+            (kept0, avail0, new_id0, pop0, lid_of0, dep_of0, nl_l0, nl_r0,
+             jnp.asarray(0, i32)))
+
+        # rebuild node arrays [nf] in pop order
+        tf = jnp.arange(nf, dtype=i32)
+        valid_t = tf < n_kept
+        old = jnp.where(valid_t, pop, 0)
+
+        def gat(a, fill=0):
+            v = jnp.take(a, old, axis=0)
+            if a.ndim > 1:
+                return jnp.where(valid_t[:, None], v, fill)
+            return jnp.where(valid_t, v, fill)
+
+        olc, orc = jnp.take(lc, old), jnp.take(rc, old)
+        olci, orci = jnp.clip(olc, 0, ni - 1), jnp.clip(orc, 0, ni - 1)
+        lk = (olc >= 0) & jnp.take(kept, olci)
+        rk = (orc >= 0) & jnp.take(kept, orci)
+        onl_l, onl_r = jnp.take(nl_l, old), jnp.take(nl_r, old)
+        left_f = jnp.where(valid_t,
+                           jnp.where(lk, jnp.take(new_id, olci), ~onl_l), 0)
+        right_f = jnp.where(valid_t,
+                            jnp.where(rk, jnp.take(new_id, orci), ~onl_r), 0)
+
+        # leaf arrays [Lp]: a kept node's side becomes a final leaf when
+        # its overgrown child there is not kept — source values are the
+        # overgrown leaf's (child < 0) or the pruned node's internal ones
+        def side_leaf(oc, is_leaf_here, nl):
+            oci = jnp.clip(oc, 0, ni - 1)
+            osl = jnp.clip(~oc, 0, Lp - 1)
+            lid = jnp.where(valid_t & is_leaf_here, nl, Lp)
+            val = jnp.where(oc >= 0, jnp.take(tree.internal_value, oci),
+                            jnp.take(tree.leaf_value, osl))
+            wgt = jnp.where(oc >= 0, jnp.take(tree.internal_weight, oci),
+                            jnp.take(tree.leaf_weight, osl))
+            cntv = jnp.where(oc >= 0, jnp.take(tree.internal_count, oci),
+                             jnp.take(tree.leaf_count, osl))
+            return lid, val, wgt, cntv
+
+        lid_l, val_l, wgt_l, cnt_l = side_leaf(olc, ~lk, onl_l)
+        lid_r, val_r, wgt_r, cnt_r = side_leaf(orc, ~rk, onl_r)
+        dep1 = jnp.take(dep_of, old) + 1
+
+        def scat(init, vl, vr):
+            return (init.at[lid_l].set(vl, mode="drop")
+                    .at[lid_r].set(vr, mode="drop"))
+
+        single = n_kept == 0                      # no kept split: 1 leaf
+        leaf_value_f = jnp.where(
+            single, jnp.zeros(Lp, f32).at[0].set(tree.leaf_value[0]),
+            scat(jnp.zeros(Lp, f32), val_l, val_r))
+        leaf_weight_f = jnp.where(
+            single, jnp.zeros(Lp, f32).at[0].set(tree.leaf_weight[0]),
+            scat(jnp.zeros(Lp, f32), wgt_l, wgt_r))
+        leaf_count_f = jnp.where(
+            single, jnp.zeros(Lp, i32).at[0].set(tree.leaf_count[0]),
+            scat(jnp.zeros(Lp, i32), cnt_l, cnt_r))
+        leaf_parent_f = jnp.where(
+            single, jnp.full(Lp, -1, i32),
+            scat(jnp.full(Lp, -1, i32), tf, tf))
+        leaf_depth_f = jnp.where(
+            single, jnp.zeros(Lp, i32),
+            scat(jnp.zeros(Lp, i32), dep1, dep1))
+
+        tree_f = TreeArrays(
+            num_leaves=n_kept + 1,
+            split_feature=gat(tree.split_feature),
+            threshold_bin=gat(tree.threshold_bin),
+            default_left=gat(tree.default_left, False),
+            split_gain=gat(tree.split_gain, 0.0),
+            left_child=left_f, right_child=right_f,
+            internal_value=gat(tree.internal_value, 0.0),
+            internal_weight=gat(tree.internal_weight, 0.0),
+            internal_count=gat(tree.internal_count),
+            leaf_value=leaf_value_f, leaf_weight=leaf_weight_f,
+            leaf_count=leaf_count_f, leaf_parent=leaf_parent_f,
+            leaf_depth=leaf_depth_f,
+            split_is_cat=gat(tree.split_is_cat, False),
+            cat_bitset=gat(tree.cat_bitset))
+
+        # rows: overgrown leaf slot -> nearest kept ancestor's side leaf.
+        # Walk up until the current node is kept (or the root is passed);
+        # overgrown depth is bounded by the wave count but chain shapes
+        # can be deep, so iterate to convergence.
+        s_ids = jnp.arange(Lp, dtype=i32)
+        node0 = tree.leaf_parent
+        side0 = jnp.where(
+            jnp.take(rc, jnp.clip(node0, 0, ni - 1)) == ~s_ids, 1, 0)
+
+        def w_cond(st):
+            node, _ = st
+            done = (node < 0) | jnp.take(kept, jnp.clip(node, 0, ni - 1))
+            return jnp.any(~done)
+
+        def w_step(st):
+            node, side = st
+            nodei = jnp.clip(node, 0, ni - 1)
+            done = (node < 0) | jnp.take(kept, nodei)
+            pnode = jnp.take(par, nodei)
+            pside = jnp.where(
+                jnp.take(rc, jnp.clip(pnode, 0, ni - 1)) == node, 1, 0)
+            return (jnp.where(done, node, pnode),
+                    jnp.where(done, side, pside))
+
+        node_w, side_w = jax.lax.while_loop(w_cond, w_step, (node0, side0))
+        nwi = jnp.clip(node_w, 0, ni - 1)
+        lid_map = jnp.where(
+            node_w >= 0,
+            jnp.where(side_w == 1, jnp.take(nl_r, nwi),
+                      jnp.take(nl_l, nwi)), 0)
+        # remap rows through the [Lp] table as a one-hot MXU matmul
+        # (byte-decomposed, bit-exact; same rationale as the recolor pass)
+        tab = jnp.stack([(lid_map & 255).astype(jnp.bfloat16),
+                         ((lid_map >> 8) & 255).astype(jnp.bfloat16)], 1)
+        ohr = (leaf_id[:, None] ==
+               s_ids[None, :]).astype(jnp.bfloat16)
+        got = jax.lax.dot_general(ohr, tab, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=f32)
+        leaf_id_f = got[:, 0].astype(i32) + (got[:, 1].astype(i32) << 8)
+        # exact final counts ride the SAME one-hot (ref: DataPartition
+        # cnt_leaf_data): per-old-slot masked counts from one extra MXU
+        # column, scattered through the [Lp] slot->leaf table — no second
+        # [n, Lp] one-hot pass
+        cnt_slot = jax.lax.dot_general(
+            row_mask.astype(jnp.bfloat16)[None, :], ohr,
+            (((1,), (0,)), ((), ())), preferred_element_type=f32)[0]
+        exact = jnp.zeros(Lp, f32).at[lid_map].add(cnt_slot).astype(i32)
+        tree_f = tree_f._replace(leaf_count=exact)
+        return tree_f, leaf_id_f
 
     tree, leaf_id = state[0], state[1]
-    if num_waves > 0:
+    if prune and num_waves > 0:
+        tree, leaf_id = _prune_to_leafwise(tree, leaf_id)
+    elif num_waves > 0:
         # exact final counts from the final partition (ref: DataPartition
         # cnt_leaf_data).  A one-hot MXU matmul instead of a 1M-element
         # scatter-add: the one-hot and 0/1 mask are exact in bf16 and the

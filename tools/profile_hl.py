@@ -72,100 +72,21 @@ def timeit(name, fn, *args):
     return best
 
 
-# ----------------------------------------------------------------------
-# decomposed kernel
-# ----------------------------------------------------------------------
-def _hl_kernel(Fg, Bh, Bl, S, P):
-    CS = C * S
-    Wd = Fg * Bl * CS
-    shift = Bl.bit_length() - 1
-
-    def kernel(rows_ref, rows_rm_ref, slot_ref, gh_ref, out_ref, cnt_ref):
-        @pl.when(pl.program_id(0) == 0)
-        def _init():
-            out_ref[...] = jnp.zeros_like(out_ref)
-            cnt_ref[...] = jnp.zeros_like(cnt_ref)
-        i32, bf16 = jnp.int32, jnp.bfloat16
-        rows = rows_ref[...].astype(i32)          # [Fg, Rt] (lanes=Rt)
-        Rt = rows.shape[1]
-        rows_rm = rows_rm_ref[...].astype(i32)    # [Rt, Fg] (sublanes=Rt)
-        slot = slot_ref[...].astype(i32)          # [Rt, 1]
-        gh = gh_ref[...]                          # [Rt, C+1]
-
-        # LHS: hi one-hot [Fg, Bh, Rt]
-        hi = rows >> shift
-        biota = jax.lax.broadcasted_iota(i32, (Fg, Bh, Rt), 1)
-        hi_oh = (hi[:, None, :] == biota).astype(bf16)
-
-        # w_sc [Rt, CS]: slot one-hot x channels (c-major)
-        soh = (slot == jax.lax.broadcasted_iota(i32, (Rt, S), 1))
-        sohb = soh.astype(bf16)
-        w_sc = jnp.concatenate(
-            [sohb * gh[:, c:c + 1].astype(bf16) for c in range(C)], axis=1)
-
-        # RHS via expander matmuls, all at full lane width:
-        lo = (rows_rm & (Bl - 1)).astype(bf16)    # [Rt, Fg]
-        ones = jnp.ones((Rt, 1), bf16)
-        lhs2 = jnp.concatenate([lo, ones], axis=1)            # [Rt, Fg+1]
-        colf = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // (Bl * CS)
-        rowi = jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 0)
-        blp = (jax.lax.broadcasted_iota(i32, (Fg + 1, Wd), 1) // CS) % Bl
-        E2 = jnp.where(rowi == Fg, (-blp).astype(bf16),
-                       (colf == rowi).astype(bf16))           # [Fg+1, Wd]
-        d = jax.lax.dot_general(lhs2, E2, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        csp = jax.lax.broadcasted_iota(i32, (S if False else C * S, Wd), 1)
-        Tm = (csp % CS ==
-              jax.lax.broadcasted_iota(i32, (CS, Wd), 0)).astype(bf16)
-        wt = jax.lax.dot_general(w_sc, Tm, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        sc = jnp.where(d == 0.0, wt, 0.0).astype(bf16)        # [Rt, Wd]
-
-        # main dots: P features per dot
-        BCS = Bl * CS
-        for f0 in range(0, Fg, P):
-            lhs = hi_oh[f0:f0 + P].reshape(P * Bh, Rt)
-            rhs = sc[:, f0 * BCS:(f0 + P) * BCS]
-            acc = jax.lax.dot_general(lhs, rhs, (((1,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-            for p in range(P):
-                out_ref[f0 + p] += acc[p * Bh:(p + 1) * Bh,
-                                       p * BCS:(p + 1) * BCS]
-        # ride-along exact counts
-        mask8 = jnp.broadcast_to(gh[:, C:C + 1].astype(bf16), (Rt, 8)).T
-        cacc = jax.lax.dot_general(mask8, sohb, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        cnt_ref[...] += cacc
-    return kernel
+# the production kernel lives in ops/histogram.py; the probe wraps it so
+# re-tuning always measures what ships
+from lightgbm_tpu.ops.histogram import (build_histogram_wave,            # noqa: E402
+                                        build_histogram_wave_hl)
 
 
-@functools.partial(jax.jit, static_argnames=("Bh", "Bl", "S", "P"))
 def hist_hl(binned_fm, binned_rm, slot, gh, *, Bh, Bl, S, P):
-    n = binned_fm.shape[1]
-    slot = slot.reshape(n, 1)
-    out, cnt = pl.pallas_call(
-        _hl_kernel(F, Bh, Bl, S, P),
-        grid=(n // Rt,),
-        in_specs=[
-            pl.BlockSpec((F, Rt), lambda i: (0, i)),
-            pl.BlockSpec((Rt, F), lambda i: (i, 0)),
-            pl.BlockSpec((Rt, 1), lambda i: (i, 0)),
-            pl.BlockSpec((Rt, C + 1), lambda i: (i, 0))],
-        out_specs=[
-            pl.BlockSpec((F, Bh, Bl * C * S), lambda i: (0, 0, 0)),
-            pl.BlockSpec((8, S), lambda i: (0, 0))],
-        out_shape=[
-            jax.ShapeDtypeStruct((F, Bh, Bl * C * S), jnp.float32),
-            jax.ShapeDtypeStruct((8, S), jnp.float32)],
-    )(binned_fm, binned_rm, slot, gh)
-    # [F, Bh, (bl, c, s)] -> [S, F, B, C]
-    h = out.reshape(F, Bh, Bl, C, S).transpose(4, 0, 1, 2, 3)
-    return h.reshape(S, F, B, C), cnt[0]
+    # Bh/Bl/P are chosen inside build_histogram_wave_hl (hl_split_of);
+    # the probe's parameter columns document the expected pick
+    return build_histogram_wave_hl(binned_fm, binned_rm, slot, gh,
+                                   max_bin=B, num_slots=S, out_slots=S,
+                                   row_tile=Rt)
 
 
 def main():
-    from lightgbm_tpu.ops.histogram import build_histogram_wave
-
     binned_fm = jnp.asarray(binned_np)
     binned_rm = jnp.asarray(binned_np.T)
     gvals = rng.randn(N, C).astype(np.float32)
@@ -181,8 +102,7 @@ def main():
         slot = jnp.asarray(slot_np)
         # correctness vs XLA reference on a small prefix
         ns = 1 << 14
-        h, cnt = jax.jit(functools.partial(hist_hl, Bh=Bh, Bl=Bl, S=S, P=P)
-                         )(binned_fm[:, :ns][:, :Rt * (ns // Rt)],
+        h, cnt = functools.partial(hist_hl, Bh=Bh, Bl=Bl, S=S, P=P)(binned_fm[:, :ns][:, :Rt * (ns // Rt)],
                            binned_rm[:ns], slot[:ns], gh[:ns])
         oh_s = (np.asarray(slot[:ns])[:, None] == np.arange(S)[None, :])
         oh_b = (binned_np[:, :ns][:, :, None] ==
