@@ -123,6 +123,26 @@ def main():
                 out["ref_sec_per_iter"] = sec
                 out["ref_host_cpus"] = ref.get("host_cpus")
                 out["vs_ref_measured"] = round(sec / elapsed, 4)
+    # BASELINE 10M-row workload (tools/bench_10m.py, >=100 timed iters on
+    # the chip) and its same-host oracle (tools/bench_oracle_10m.py):
+    # folded into the single driver line when measured this round
+    for fname, prefix, keys in (
+            ("bench_10m.json", "b10m_",
+             ("sec_per_iter", "auc", "iters", "vs_baseline_28core_2015",
+              "useful_mac_mfu", "measured_at")),
+            ("oracle_bench_10m.json", "b10m_ref_",
+             ("ref_sec_per_iter", "ref_auc_at_iters", "host_cpus"))):
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "docs", fname)
+        if os.path.exists(p):
+            try:
+                d = json.load(open(p))
+            except (OSError, ValueError):
+                continue
+            if d.get("rows") == 10_000_000:
+                for k in keys:
+                    if d.get(k) is not None:
+                        out[prefix + k.replace("ref_", "")] = d[k]
     print(json.dumps(out))
 
 

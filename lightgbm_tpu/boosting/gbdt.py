@@ -529,14 +529,16 @@ class GBDT:
             self.grow_params = self.grow_params._replace(
                 interaction_sets=tuple(sets))
         if (self.grow_params.forced_splits
-                or self.grow_params.interaction_sets
                 or self.grow_params.voting is not None
                 or self.grow_params.monotone_intermediate
                 or self.grow_params.split.has_cegb_lazy):
+            # interaction constraints run on the wave engine (per-leaf
+            # branch masks compose with waves AND with prune: allowed
+            # features depend only on the leaf's path)
             if strategy == "wave":
-                log.warning("forced splits / interaction constraints / "
-                            "voting / intermediate monotone mode use the "
-                            "leaf-wise engine")
+                log.warning("forced splits / voting / intermediate "
+                            "monotone / lazy CEGB use the leaf-wise "
+                            "engine")
             strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"

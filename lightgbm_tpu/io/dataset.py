@@ -217,7 +217,6 @@ class Dataset:
             ds.feature_names = reference.feature_names
             ds.max_bin = reference.max_bin
         else:
-            cat_set = set(categorical_feature or [])
             # sample rows for bin finding (ref: config `bin_construct_sample_cnt`)
             if n > bin_construct_sample_cnt:
                 rng = np.random.RandomState(seed)
@@ -225,35 +224,15 @@ class Dataset:
                 sample = data[sample_idx]
             else:
                 sample = data
-            total_sample_cnt = len(sample)
-            forced_bins = get_forced_bins(forcedbins_filename, num_features,
-                                          cat_set)
-            ds.bin_mappers = []
-            for f in range(num_features):
-                col = sample[:, f]
-                # reference samples *non-zero* values; zeros are implied counts
-                from .binning import prep_find_bin_values
-                vals = prep_find_bin_values(col)
-                mapper = BinMapper()
-                fmax_bin = (int(max_bin_by_feature[f])
-                            if max_bin_by_feature else max_bin)
-                mapper.find_bin(
-                    vals, total_sample_cnt, fmax_bin,
-                    min_data_in_bin=min_data_in_bin,
-                    min_split_data=min_data_in_leaf,
-                    pre_filter=feature_pre_filter,
-                    bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
-                    use_missing=use_missing, zero_as_missing=zero_as_missing,
-                    forced_upper_bounds=forced_bins[f])
-                ds.bin_mappers.append(mapper)
-            ds.used_feature_map = []
-            ds.used_features = []
-            for f, m in enumerate(ds.bin_mappers):
-                if m.is_trivial:
-                    ds.used_feature_map.append(-1)
-                else:
-                    ds.used_feature_map.append(len(ds.used_features))
-                    ds.used_features.append(f)
+            ds._build_mappers(
+                sample, len(sample), max_bin=max_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_data_in_leaf=min_data_in_leaf,
+                categorical_feature=categorical_feature,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                feature_pre_filter=feature_pre_filter,
+                max_bin_by_feature=max_bin_by_feature,
+                forcedbins_filename=forcedbins_filename)
 
         # bin every used feature (ref: ExtractFeaturesFromMemory PushOneRow)
         binned = np.empty((len(ds.used_features), n), dtype=np.int32)
@@ -270,6 +249,166 @@ class Dataset:
         ds.metadata = md
         if keep_raw_data:
             ds.raw_data = data
+        return ds
+
+    # ------------------------------------------------------------------
+    def _build_mappers(self, sample, total_sample_cnt, *, max_bin,
+                       min_data_in_bin, min_data_in_leaf,
+                       categorical_feature, use_missing, zero_as_missing,
+                       feature_pre_filter, max_bin_by_feature,
+                       forcedbins_filename):
+        """BinMappers + used-feature map from a sample matrix (ref:
+        dataset_loader.cpp:593 ConstructFromSampleData)."""
+        from .binning import prep_find_bin_values
+        num_features = self.num_total_features
+        cat_set = set(categorical_feature or [])
+        forced_bins = get_forced_bins(forcedbins_filename, num_features,
+                                      cat_set)
+        self.bin_mappers = []
+        for f in range(num_features):
+            # reference samples *non-zero* values; zeros are implied counts
+            vals = prep_find_bin_values(sample[:, f])
+            mapper = BinMapper()
+            fmax_bin = (int(max_bin_by_feature[f])
+                        if max_bin_by_feature else max_bin)
+            mapper.find_bin(
+                vals, total_sample_cnt, fmax_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_split_data=min_data_in_leaf,
+                pre_filter=feature_pre_filter,
+                bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                forced_upper_bounds=forced_bins[f])
+            self.bin_mappers.append(mapper)
+        self.used_feature_map = []
+        self.used_features = []
+        for f, m in enumerate(self.bin_mappers):
+            if m.is_trivial:
+                self.used_feature_map.append(-1)
+            else:
+                self.used_feature_map.append(len(self.used_features))
+                self.used_features.append(f)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def construct_from_stream(
+            cls, stream_factory, num_features: Optional[int] = None,
+            weight=None, group=None,
+            max_bin: int = 255, min_data_in_bin: int = 3,
+            min_data_in_leaf: int = 20,
+            bin_construct_sample_cnt: int = 200000,
+            categorical_feature=None, feature_names=None,
+            use_missing: bool = True, zero_as_missing: bool = False,
+            feature_pre_filter: bool = True, seed: int = 1,
+            max_bin_by_feature=None,
+            forcedbins_filename: str = "") -> "Dataset":
+        """Out-of-core (two-round) construction: bounded-memory streaming
+        ingestion of data larger than RAM (ref: config.h `two_round`;
+        dataset_loader.cpp:960 LoadTextDataToMemory is the ONE-round path
+        this avoids, :1022 SampleTextDataFromFile + :1100
+        ExtractFeaturesFromFile are the two file passes mirrored here).
+
+        `stream_factory()` must return a fresh iterator of
+        (feats [c, F] float, labels [c]) chunks each time it is called;
+        chunk widths may grow over the stream (sparse LibSVM reveals its
+        max feature index late) — narrower chunks are zero-padded.
+        Pass 1 reservoir-samples rows for bin finding and counts rows;
+        pass 2 streams again and bins each chunk straight into the packed
+        [F_used, n] code matrix.  Peak memory is one chunk + the sample
+        + the binned codes — the raw float matrix never materializes.
+        """
+        rng = np.random.RandomState(seed)
+        cap = max(1, int(bin_construct_sample_cnt))
+        sample_buf = None
+        filled = 0
+        n = 0
+        labels_parts = []
+        # pass 1: count + reservoir sample (Vitter R, vectorized per
+        # chunk: draws are batched; only accepted rows touch the buffer)
+        for feats, labels in stream_factory():
+            feats = np.asarray(feats, np.float64)
+            c = feats.shape[0]
+            if labels is not None:
+                labels_parts.append(np.asarray(labels, np.float32))
+            if sample_buf is None:
+                sample_buf = np.zeros((cap, feats.shape[1]), np.float64)
+            elif feats.shape[1] > sample_buf.shape[1]:
+                # LibSVM width growth: widen with implicit zeros
+                sample_buf = np.pad(
+                    sample_buf,
+                    ((0, 0), (0, feats.shape[1] - sample_buf.shape[1])))
+            elif feats.shape[1] < sample_buf.shape[1]:
+                feats = np.pad(
+                    feats,
+                    ((0, 0), (0, sample_buf.shape[1] - feats.shape[1])))
+            take = min(cap - filled, c)
+            if take > 0:
+                sample_buf[filled:filled + take] = feats[:take]
+                filled += take
+            if take < c:
+                seen = n + take + np.arange(1, c - take + 1)
+                js = (rng.random_sample(c - take) * seen).astype(np.int64)
+                hits = np.nonzero(js < cap)[0]
+                for i in hits:            # expected O(cap * ln) accepts
+                    sample_buf[js[i]] = feats[take + i]
+            n += c
+        if n == 0:
+            log.fatal("Empty data stream")
+        sample = sample_buf[:filled]
+        if num_features is None:
+            num_features = sample.shape[1]
+        elif sample.shape[1] != num_features:
+            log.fatal(f"Stream width {sample.shape[1]} != declared "
+                      f"num_features {num_features}")
+
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = num_features
+        ds.max_bin = max_bin
+        ds.feature_names = ([str(s) for s in feature_names]
+                            if feature_names is not None else
+                            [f"Column_{i}" for i in range(num_features)])
+        ds._build_mappers(
+            sample, len(sample), max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin,
+            min_data_in_leaf=min_data_in_leaf,
+            categorical_feature=categorical_feature,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            feature_pre_filter=feature_pre_filter,
+            max_bin_by_feature=max_bin_by_feature,
+            forcedbins_filename=forcedbins_filename)
+        del sample
+
+        # pass 2: stream again, bin chunks directly into the code matrix
+        # (uint8 when every feature fits — 4x less resident memory and
+        # device transfer than int32; ref Experiments.rst:160 two_round
+        # peak-RAM table is the behavior being matched)
+        narrow = all(m.num_bin <= 256 for m in ds.bin_mappers)
+        code_t = np.uint8 if narrow else np.int32
+        binned = np.empty((len(ds.used_features), n), dtype=code_t)
+        off = 0
+        for feats, _ in stream_factory():
+            feats = np.asarray(feats, np.float64)
+            c = feats.shape[0]
+            if off + c > n:
+                log.fatal("Stream yielded more rows on pass 2 than pass 1")
+            if feats.shape[1] < num_features:   # LibSVM implicit zeros
+                feats = np.pad(
+                    feats, ((0, 0), (0, num_features - feats.shape[1])))
+            for inner, f in enumerate(ds.used_features):
+                binned[inner, off:off + c] = \
+                    ds.bin_mappers[f].values_to_bins(feats[:, f])
+            off += c
+        if off != n:
+            log.fatal(f"Stream yielded {off} rows on pass 2, {n} on pass 1")
+        ds.binned = binned
+
+        md = Metadata(n)
+        if labels_parts:
+            md.set_label(np.concatenate(labels_parts))
+        md.set_weight(weight)
+        md.set_group(group)
+        ds.metadata = md
         return ds
 
     # ------------------------------------------------------------------
@@ -394,6 +533,88 @@ class Dataset:
         return ds
 
 
+def _read_side_files(path: str):
+    """.weight / .query sidecar files (ref: metadata.cpp LoadWeights /
+    LoadQueryBoundaries)."""
+    weight = group = None
+    try:
+        with open(path + ".weight") as f:
+            weight = np.array([float(x) for x in f.read().split()],
+                              dtype=np.float32)
+    except FileNotFoundError:
+        pass
+    try:
+        with open(path + ".query") as f:
+            group = np.array([int(x) for x in f.read().split()],
+                             dtype=np.int64)
+    except FileNotFoundError:
+        pass
+    return weight, group
+
+
+def _parse_categorical(cfg, names) -> List[int]:
+    """categorical_feature tokens -> column indices; `name:` tokens
+    resolve against header names (ref: dataset_loader.cpp:35 SetHeader)."""
+    cat_features: List[int] = []
+    if cfg.categorical_feature:
+        for tok in str(cfg.categorical_feature).split(","):
+            tok = tok.strip()
+            if tok.startswith("name:"):
+                if names and tok[5:] in names:
+                    cat_features.append(names.index(tok[5:]))
+                else:
+                    log.warning(f"categorical_feature {tok!r} not found "
+                                "in header names; ignored")
+            elif tok:
+                cat_features.append(int(tok))
+    return cat_features
+
+
+def _load_two_round(path: str, cfg) -> Dataset:
+    """two_round=true file loading (ref: config.h two_round;
+    dataset_loader.cpp:1022 SampleTextDataFromFile + :1100
+    ExtractFeaturesFromFile): the file is streamed twice and the raw
+    float matrix never materializes — peak RAM is one parse chunk + the
+    bin-finding sample + the packed bin codes, matching the reference's
+    Higgs two_round peak-RAM behavior (docs/Experiments.rst:160)."""
+    from .parser import (_header_names_of, _label_index,
+                         parse_file_stream)
+
+    if cfg.linear_tree:
+        # the reference rejects the combination (config.cpp: "Cannot use
+        # two_round loading with linear tree"): linear leaves need the
+        # raw values that two_round exists to not hold
+        log.fatal("Cannot use two_round loading with linear tree")
+
+    names = None
+    if cfg.header:
+        with open(path) as f:
+            header_names = _header_names_of(f.readline().rstrip("\n\r"))
+        li = _label_index(cfg.label_column, header_names)
+        names = [h for i, h in enumerate(header_names) if i != li]
+
+    def stream():
+        # smaller chunks than the predict path: the parse transients
+        # (joined text + float matrix + label split) are the two_round
+        # loader's peak-memory driver
+        return parse_file_stream(path, has_header=cfg.header,
+                                 label_column=cfg.label_column,
+                                 chunk_rows=16384)
+
+    weight, group = _read_side_files(path)
+    return Dataset.construct_from_stream(
+        stream, weight=weight, group=group,
+        max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+        categorical_feature=_parse_categorical(cfg, names),
+        feature_names=names, use_missing=cfg.use_missing,
+        zero_as_missing=cfg.zero_as_missing,
+        feature_pre_filter=cfg.feature_pre_filter,
+        seed=cfg.data_random_seed,
+        forcedbins_filename=cfg.forcedbins_filename)
+
+
 def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = None,
                            reference: Optional[Dataset] = None) -> Dataset:
     """File -> Dataset pipeline (ref: dataset_loader.cpp LoadFromFile)."""
@@ -405,6 +626,8 @@ def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = 
             return Dataset.load_binary(path)
         except (FileNotFoundError, OSError, KeyError, ValueError):
             pass
+    if cfg.two_round and reference is None:
+        return _load_two_round(path, cfg)
     feats, labels, names = parse_file(path, has_header=cfg.header,
                                       label_column=cfg.label_column)
     weight = None

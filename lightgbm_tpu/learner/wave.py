@@ -184,6 +184,23 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                        1.0 - jnp.exp2(pen - 1.0 - d)
                                        + 1e-15))
 
+    use_interaction = bool(params.interaction_sets)
+    if use_interaction:
+        _iset_masks = jnp.stack([
+            jnp.zeros(num_features, bool).at[jnp.asarray(S, jnp.int32)]
+            .set(True) for S in params.interaction_sets])    # [S, F]
+
+        def _allowed_of(branch):
+            """[NLp, F] branch masks -> [NLp, F] allowed masks (ref:
+            col_sampler.hpp:91 GetByNode, vectorized over leaves): a
+            feature is allowed iff it lies in some constraint set that
+            contains the leaf's whole branch, or is itself on the
+            branch."""
+            ok = ~jnp.any(branch[:, None, :] & ~_iset_masks[None, :, :],
+                          axis=2)                            # [NLp, S]
+            return branch | jnp.any(
+                ok[:, :, None] & _iset_masks[None, :, :], axis=1)
+
     use_bynode = params.feature_fraction_bynode < 1.0
     if use_bynode:
         _bynode_key = jax.random.PRNGKey(params.bynode_seed)
@@ -229,7 +246,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if (sp.extra_trees
                                       and sp.has_categorical) else None,
                                 None,
-                                0 if use_bynode else None))
+                                0 if (use_bynode or use_interaction)
+                                else None))
 
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
@@ -358,7 +376,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         Ks is the TRUE (unpadded) computed-slot bound for the decomposed
         small-S histogram kernel."""
         (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
-         leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
+         leaf_cmin, leaf_cmax, used_vec, leaf_branch, cache_h, cache_c,
          pend_sel, pend_new, pend_rank, pend_sl, _) = state
         NL = tree.num_leaves
 
@@ -380,6 +398,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                      else (None, None, None))
         bym = (_bynode_masks(tree.num_leaves)[:NLp] if use_bynode
                else None)
+        if use_interaction:
+            allow = _allowed_of(leaf_branch[:NLp])
+            bym = allow if bym is None else (bym & allow)
         best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
                        counts, leaf_out[:NLp], *mono_args, rb, rcu,
                        used_vec, bym)
@@ -463,6 +484,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_sum_h = lset(leaf_sum_h, best.left_sum_hessian,
                           best.right_sum_hessian)
         leaf_out = lset(leaf_out, best.left_output, best.right_output)
+        if use_interaction:
+            # children extend the branch with the winning feature (ref:
+            # col_sampler.hpp used_feature_indices_ per-branch tracking)
+            fb = (jnp.arange(num_features, dtype=i32)[None, :]
+                  == best.feature[:, None]) & split_sel[:, None]
+            newb = leaf_branch[:NLp] | fb
+            leaf_branch = lset(leaf_branch, newb, newb)
         if sp.has_monotone:
             # basic-mode constraint propagation (BasicLeafConstraints::
             # Update): children bounded at the output midpoint
@@ -596,15 +624,17 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         pend_sl = jnp.zeros(Lp, bool).at[:NLp].set(small_left)
         cont = (n_split > 0) & (tree.num_leaves < Lg)
         return (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
-                leaf_cmin, leaf_cmax, used_vec, cache_h, cache_c,
-                pend_sel, pend_new, pend_rank, pend_sl, cont)
+                leaf_cmin, leaf_cmax, used_vec, leaf_branch, cache_h,
+                cache_c, pend_sel, pend_new, pend_rank, pend_sl, cont)
 
     if cegb_used is None:
         cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
+    leaf_branch0 = jnp.zeros(
+        (Lp, num_features) if use_interaction else (1, 1), bool)
     state = (tree, jnp.zeros(n, i32), jnp.zeros(n, i32), leaf_sum_g0,
              leaf_sum_h0, leaf_out0, leaf_cmin0, leaf_cmax0, cegb_used,
-             cache_h0, cache_c0, pend_sel0, pend_new0, pend_rank0, pend_sl0,
-             jnp.asarray(L > 1))
+             leaf_branch0, cache_h0, cache_c0, pend_sel0, pend_new0,
+             pend_rank0, pend_sl0, jnp.asarray(L > 1))
     num_waves = max(1, math.ceil(math.log2(Lg))) if Lg > 1 else 0
     for k in range(num_waves):
         NLp = wave_slot_pad(min(1 << k, Lg))

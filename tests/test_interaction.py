@@ -71,3 +71,34 @@ def test_interaction_constraints_list_form():
     for t in b._gbdt.models_:
         for feats in _collect_paths(t):
             assert any(feats <= s for s in allowed), feats
+
+
+def test_interaction_on_wave_engine_matches_leafwise():
+    """Interaction constraints run on the wave engine (per-leaf branch
+    masks): branches must respect the sets, and under full overgrowth
+    coverage the pruned wave tree must equal the leaf-wise tree
+    structurally (the allowed-feature mask depends only on the path, so
+    kept gains are unchanged)."""
+    rng = np.random.RandomState(6)
+    n = 3000
+    X = rng.rand(n, 4)
+    y = X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.05 * rng.randn(n)
+    params = {"objective": "regression", "num_leaves": 15, "max_depth": 5,
+              "verbosity": -1, "min_data_in_leaf": 5,
+              "interaction_constraints": "[0,1],[2,3]",
+              "wave_prune_overshoot": 2.2}
+    b_w = lgb.train({**params, "tpu_growth_strategy": "wave"},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    b_l = lgb.train({**params, "tpu_growth_strategy": "leafwise"},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    b_w._gbdt._sync_model(); b_l._gbdt._sync_model()
+    allowed = [{0, 1}, {2, 3}]
+    for t in b_w._gbdt.models_:
+        for feats in _collect_paths(t):
+            assert any(feats <= s for s in allowed), feats
+    for m_w, m_l in zip(b_w._gbdt.models_, b_l._gbdt.models_):
+        assert m_w.num_leaves == m_l.num_leaves
+        np.testing.assert_array_equal(np.asarray(m_w.split_feature),
+                                      np.asarray(m_l.split_feature))
+        np.testing.assert_array_equal(np.asarray(m_w.threshold_in_bin),
+                                      np.asarray(m_l.threshold_in_bin))

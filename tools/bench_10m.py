@@ -1,0 +1,109 @@
+"""BASELINE workload bench: Higgs-scale 10M rows x 28 features x 255
+leaves, >= 100 timed iterations on the real chip (BASELINE.md target #2;
+ref docs/Experiments.rst:110-123 trains 10.5M rows in 0.260 s/iter on a
+2015 28-core box).
+
+Writes docs/bench_10m.json; bench.py folds the numbers into its single
+driver JSON line.  Also derives the MFU/roofline accounting PERF_NOTES.md
+reports: per-iteration streamed one-hot volume from the wave ladder
+model, achieved bytes/s against the v5e's ~2 TB/s VMEM bandwidth, and
+useful-MAC utilization.
+
+Usage: python tools/bench_10m.py  [BENCH10M_ROWS=... BENCH10M_ITERS=...]
+"""
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import FEATURES, _auc, make_higgs_like
+
+ROWS = int(os.environ.get("BENCH10M_ROWS", 10_000_000))
+ITERS = int(os.environ.get("BENCH10M_ITERS", 100))
+WARMUP = 3
+NUM_LEAVES = 255
+MAX_BIN = 255
+TEST_ROWS = 500_000
+
+
+def ladder_volume_model(n, F=FEATURES, B=256, L=NUM_LEAVES, C=2,
+                        overshoot=1.5):
+    """Estimated one-hot lane-elements materialized+read per iteration by
+    the wave ladder (PERF_NOTES.md): full kernel streams ~3.5 passes of
+    F*B per row per wave; the decomposed hi/lo kernel (S<=8) streams
+    ~4 passes of F*(Bh) + ~6 of F*(Bl*C*S) (fp32 intermediates counted
+    double).  Used only for the roofline REPORT, not for timing."""
+    from lightgbm_tpu.ops.histogram import hl_split_of, wave_hl_profitable
+    Lg = min(max(L, int(math.ceil(L * overshoot))), 4 * L)
+    num_waves = max(1, math.ceil(math.log2(Lg)))
+    kss = [min(1 << max(k - 1, 0), Lg) for k in range(num_waves)]
+    kss.append(max(Lg // 2, 1))          # the while-loop tail wave
+    units = 0.0
+    for S in kss:
+        if wave_hl_profitable(B, S, C):
+            Bh, Bl = hl_split_of(B, S, C)
+            units += F * (4.0 * Bh + 6.0 * Bl * C * S)
+        else:
+            units += 3.5 * F * B
+    return units * n * 2.0               # bf16 bytes
+
+
+def main():
+    import jax
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(ROWS, FEATURES)
+    Xte, yte = make_higgs_like(TEST_ROWS, FEATURES, seed=1)
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "learning_rate": 0.1, "max_bin": MAX_BIN,
+              "min_data_in_leaf": 20, "verbosity": -1, "metric": "none"}
+    t0 = time.time()
+    booster = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    for _ in range(WARMUP):
+        booster.update()
+    _ = np.asarray(booster._gbdt.scores[0][:8])
+    setup_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(ITERS):
+        booster.update()
+    _ = np.asarray(booster._gbdt.scores[0][:8])
+    sec_per_iter = (time.time() - t0) / ITERS
+    auc = _auc(yte, booster._gbdt.predict_raw(Xte))
+
+    bytes_per_iter = ladder_volume_model(ROWS)
+    tbps = bytes_per_iter / sec_per_iter / 1e12
+    # useful accumulation = one MAC per (row, feature, channel) per wave
+    waves = max(1, math.ceil(math.log2(int(NUM_LEAVES * 1.5)))) + 1
+    useful_macs = ROWS * FEATURES * 3 * waves
+    mfu = useful_macs * 2 / sec_per_iter / 197e12  # v5e bf16 peak
+
+    out = {
+        "rows": ROWS, "features": FEATURES, "num_leaves": NUM_LEAVES,
+        "iters": WARMUP + ITERS, "sec_per_iter": round(sec_per_iter, 4),
+        "rows_per_sec_per_iter": round(ROWS / sec_per_iter),
+        "auc": round(auc, 5),
+        "setup_s": round(setup_s, 1),
+        "vs_baseline_28core_2015": round(
+            (0.260194 * ROWS / 10_500_000) / sec_per_iter, 4),
+        "est_streamed_bytes_per_iter": round(bytes_per_iter),
+        "est_achieved_tbps": round(tbps, 3),
+        "est_vmem_bw_frac": round(tbps / 2.0, 3),
+        "useful_mac_mfu": round(mfu, 5),
+        "backend": jax.default_backend(),
+        "measured_at": time.strftime("%Y-%m-%d"),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_10m.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
