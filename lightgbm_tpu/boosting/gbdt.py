@@ -838,6 +838,16 @@ class GBDT:
         """Per-class gradients [K, n_pad] (ref: gbdt.cpp:220 Boosting)."""
         obj = self.objective
         if getattr(obj, "run_on_host", False):
+            # ranking objectives with a device program (bucketed pairwise
+            # lambdas, ranking.py make_device_grad_fn) skip the
+            # host round-trip entirely; the per-query host loop remains
+            # for the position-bias mode and rank_xendcg
+            dev_fn = getattr(self, "_ranking_dev_fn", None)
+            if dev_fn is None and hasattr(obj, "make_device_grad_fn"):
+                dev_fn = obj.make_device_grad_fn(self.n_pad)
+                self._ranking_dev_fn = dev_fn if dev_fn else False
+            if dev_fn:
+                return dev_fn(self.scores, self.weight_dev)
             score_h = np.asarray(self._slice_row_fn(
                 self.scores, 0))[:self.num_data].astype(np.float64)
             g, h = obj.get_gradients_host(score_h)

@@ -301,7 +301,8 @@ class Dataset:
             use_missing: bool = True, zero_as_missing: bool = False,
             feature_pre_filter: bool = True, seed: int = 1,
             max_bin_by_feature=None,
-            forcedbins_filename: str = "") -> "Dataset":
+            forcedbins_filename: str = "",
+            reference: Optional["Dataset"] = None) -> "Dataset":
         """Out-of-core (two-round) construction: bounded-memory streaming
         ingestion of data larger than RAM (ref: config.h `two_round`;
         dataset_loader.cpp:960 LoadTextDataToMemory is the ONE-round path
@@ -318,7 +319,10 @@ class Dataset:
         + the binned codes — the raw float matrix never materializes.
         """
         rng = np.random.RandomState(seed)
-        cap = max(1, int(bin_construct_sample_cnt))
+        # with a reference dataset the mappers are reused, so pass 1 only
+        # counts rows and collects labels — keep the reservoir tiny
+        cap = (1 if reference is not None
+               else max(1, int(bin_construct_sample_cnt)))
         sample_buf = None
         filled = 0
         n = 0
@@ -355,7 +359,9 @@ class Dataset:
         if n == 0:
             log.fatal("Empty data stream")
         sample = sample_buf[:filled]
-        if num_features is None:
+        if reference is not None:
+            num_features = reference.num_total_features
+        elif num_features is None:
             num_features = sample.shape[1]
         elif sample.shape[1] != num_features:
             log.fatal(f"Stream width {sample.shape[1]} != declared "
@@ -368,15 +374,28 @@ class Dataset:
         ds.feature_names = ([str(s) for s in feature_names]
                             if feature_names is not None else
                             [f"Column_{i}" for i in range(num_features)])
-        ds._build_mappers(
-            sample, len(sample), max_bin=max_bin,
-            min_data_in_bin=min_data_in_bin,
-            min_data_in_leaf=min_data_in_leaf,
-            categorical_feature=categorical_feature,
-            use_missing=use_missing, zero_as_missing=zero_as_missing,
-            feature_pre_filter=feature_pre_filter,
-            max_bin_by_feature=max_bin_by_feature,
-            forcedbins_filename=forcedbins_filename)
+        if reference is not None:
+            # validation-set alignment: reuse the training mappers
+            # (ref: LoadFromFileAlignWithOtherDataset) — the sample pass
+            # only counted rows and collected labels
+            if reference.num_total_features != num_features:
+                log.fatal("Validation data feature count mismatch with "
+                          "reference Dataset")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.used_features = reference.used_features
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+        else:
+            ds._build_mappers(
+                sample, len(sample), max_bin=max_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_data_in_leaf=min_data_in_leaf,
+                categorical_feature=categorical_feature,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                feature_pre_filter=feature_pre_filter,
+                max_bin_by_feature=max_bin_by_feature,
+                forcedbins_filename=forcedbins_filename)
         del sample
 
         # pass 2: stream again, bin chunks directly into the code matrix
@@ -570,7 +589,8 @@ def _parse_categorical(cfg, names) -> List[int]:
     return cat_features
 
 
-def _load_two_round(path: str, cfg) -> Dataset:
+def _load_two_round(path: str, cfg, reference: Optional[Dataset] = None
+                    ) -> Dataset:
     """two_round=true file loading (ref: config.h two_round;
     dataset_loader.cpp:1022 SampleTextDataFromFile + :1100
     ExtractFeaturesFromFile): the file is streamed twice and the raw
@@ -612,7 +632,8 @@ def _load_two_round(path: str, cfg) -> Dataset:
         zero_as_missing=cfg.zero_as_missing,
         feature_pre_filter=cfg.feature_pre_filter,
         seed=cfg.data_random_seed,
-        forcedbins_filename=cfg.forcedbins_filename)
+        forcedbins_filename=cfg.forcedbins_filename,
+        reference=reference)
 
 
 def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = None,
@@ -626,31 +647,12 @@ def load_dataset_from_file(path: str, config_params: Optional[Dict[str, Any]] = 
             return Dataset.load_binary(path)
         except (FileNotFoundError, OSError, KeyError, ValueError):
             pass
-    if cfg.two_round and reference is None:
-        return _load_two_round(path, cfg)
+    if cfg.two_round:
+        return _load_two_round(path, cfg, reference=reference)
     feats, labels, names = parse_file(path, has_header=cfg.header,
                                       label_column=cfg.label_column)
-    weight = None
-    try:
-        with open(path + ".weight") as f:
-            weight = np.array([float(x) for x in f.read().split()], dtype=np.float32)
-    except FileNotFoundError:
-        pass
-    group = None
-    try:
-        with open(path + ".query") as f:
-            group = np.array([int(x) for x in f.read().split()], dtype=np.int64)
-    except FileNotFoundError:
-        pass
-    cat_features: List[int] = []
-    if cfg.categorical_feature:
-        for tok in str(cfg.categorical_feature).split(","):
-            tok = tok.strip()
-            if tok.startswith("name:"):
-                if names and tok[5:] in names:
-                    cat_features.append(names.index(tok[5:]))
-            elif tok:
-                cat_features.append(int(tok))
+    weight, group = _read_side_files(path)
+    cat_features = _parse_categorical(cfg, names)
     if reference is not None:
         ds = reference.create_valid(feats, label=labels, weight=weight, group=group)
     else:

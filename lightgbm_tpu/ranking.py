@@ -98,7 +98,15 @@ class RankingObjective(ObjectiveFunction):
 
 
 class LambdarankNDCG(RankingObjective):
-    """ref: rank_objective.hpp:131 LambdarankNDCG."""
+    """ref: rank_objective.hpp:131 LambdarankNDCG.
+
+    Gradients run ON DEVICE by default (make_device_grad_fn): queries are
+    bucketed by padded pow2 length, each bucket computes its pairwise
+    lambdas as one masked [Qb, T, m] tensor program (the TPU analogue of
+    the per-query CUDA kernels in cuda_rank_objective.cu:131
+    GetGradientsKernel_LambdarankNDCG), and results scatter back through
+    the precomputed doc-index map.  The host per-query loop remains as
+    the fallback for position bias (its Newton state is host-side)."""
     name = "lambdarank"
 
     def __init__(self, config: Config):
@@ -124,6 +132,125 @@ class LambdarankNDCG(RankingObjective):
             k = min(self.truncation_level, b - a)
             max_dcg = float((g[:k] * disc[:k]).sum())
             self.inverse_max_dcgs[q] = 1.0 / max_dcg if max_dcg > 0 else 0.0
+
+    # ------------------------------------------------------------------
+    def make_device_grad_fn(self, n_pad: int):
+        """Build the jitted device gradient program, or None when the
+        host path must run (position bias carries host Newton state).
+
+        Bucket tensors (doc indices, labels, valid masks, 1/maxDCG) are
+        passed as explicit jit arguments — closing over large device
+        arrays embeds them as constants, which degrades every subsequent
+        dispatch on the remote-TPU runtime (see gbdt.py _grad_fn note).
+        """
+        if self.positions is not None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        qb = self.query_boundaries
+        lens = np.diff(qb).astype(np.int64)
+        buckets = {}
+        for q, ln in enumerate(lens):
+            m = max(8, 1 << int(ln - 1).bit_length())
+            buckets.setdefault(m, []).append(q)
+        self._dev_buckets = []
+        for m, qs in sorted(buckets.items()):
+            Qb = len(qs)
+            idx = np.full((Qb, m), n_pad - 1, np.int32)
+            lab = np.zeros((Qb, m), np.int32)
+            val = np.zeros((Qb, m), bool)
+            imd = np.zeros(Qb, np.float32)
+            for r, q in enumerate(qs):
+                a, b = int(qb[q]), int(qb[q + 1])
+                idx[r, :b - a] = np.arange(a, b)
+                lab[r, :b - a] = self.label[a:b].astype(np.int32)
+                val[r, :b - a] = True
+                imd[r] = self.inverse_max_dcgs[q]
+            self._dev_buckets.append(dict(
+                m=m, idx=jnp.asarray(idx), lab=jnp.asarray(lab),
+                val=jnp.asarray(val), imd=jnp.asarray(imd)))
+        lg = jnp.asarray(self.label_gain, jnp.float32)
+        sigmoid, norm, trunc = self.sigmoid, self.norm, self.truncation_level
+        f32 = jnp.float32
+
+        def bucket_lambdas(sc_b, lab_b, val_b, imd_b, m):
+            """[Qb, m] padded query block -> (lambdas, hessians) in the
+            block's doc positions (mirrors _one_query, vectorized)."""
+            Tm = max(1, min(trunc, m - 1))
+            key = jnp.where(val_b, sc_b, -jnp.inf)
+            order = jnp.argsort(-key, axis=1, stable=True)
+            ss = jnp.take_along_axis(sc_b, order, 1)
+            sl = jnp.take_along_axis(lab_b, order, 1)
+            sv = jnp.take_along_axis(val_b, order, 1)
+            ssz = jnp.where(sv, ss, 0.0)
+            cnt = jnp.sum(sv.astype(jnp.int32), axis=1)
+            gains = jnp.take(lg, jnp.clip(sl, 0, lg.shape[0] - 1))
+            disc = (1.0 / jnp.log2(jnp.arange(m, dtype=f32) + 2.0))
+            best = ssz[:, 0]
+            worst = jnp.take_along_axis(
+                ssz, jnp.maximum(cnt - 1, 0)[:, None], 1)[:, 0]
+            gi, gj = gains[:, :Tm, None], gains[:, None, :]
+            si, sj = ssz[:, :Tm, None], ssz[:, None, :]
+            di, dj = disc[None, :Tm, None], disc[None, None, :]
+            li, lj = sl[:, :Tm, None], sl[:, None, :]
+            pair_ok = ((jnp.arange(m)[None, None, :]
+                        > jnp.arange(Tm)[None, :, None])
+                       & (li != lj) & sv[:, :Tm, None] & sv[:, None, :])
+            delta_ndcg = (jnp.abs(gi - gj) * jnp.abs(di - dj)
+                          * imd_b[:, None, None])
+            if norm:
+                dsa = jnp.abs(si - sj)
+                delta_ndcg = jnp.where(
+                    (best != worst)[:, None, None],
+                    delta_ndcg / (0.01 + dsa), delta_ndcg)
+            i_is_high = li > lj
+            d_s = jnp.where(i_is_high, si - sj, sj - si)
+            p = 1.0 / (1.0 + jnp.exp(sigmoid * d_s))
+            p_lambda = jnp.where(pair_ok, -sigmoid * delta_ndcg * p, 0.0)
+            p_hess = jnp.where(pair_ok,
+                               p * (1.0 - p) * sigmoid * sigmoid
+                               * delta_ndcg, 0.0)
+            sign_i = jnp.where(i_is_high, 1.0, -1.0)
+            lam_s = jnp.zeros_like(sc_b).at[:, :Tm].add(
+                jnp.sum(p_lambda * sign_i, axis=2))
+            lam_s = lam_s + jnp.sum(-p_lambda * sign_i, axis=1)
+            hes_s = jnp.zeros_like(sc_b).at[:, :Tm].add(
+                jnp.sum(p_hess, axis=2))
+            hes_s = hes_s + jnp.sum(p_hess, axis=1)
+            if norm:
+                sum_lam = -2.0 * jnp.sum(p_lambda, axis=(1, 2))
+                nf = jnp.where(sum_lam > 0,
+                               jnp.log2(1.0 + sum_lam)
+                               / jnp.maximum(sum_lam, K_EPSILON), 1.0)
+                lam_s = lam_s * nf[:, None]
+                hes_s = hes_s * nf[:, None]
+            inv_order = jnp.argsort(order, axis=1)
+            lam = jnp.take_along_axis(lam_s, inv_order, 1)
+            hes = jnp.take_along_axis(hes_s, inv_order, 1)
+            return lam, hes
+
+        def grad_fn(scores, weight, bucket_args):
+            sc = scores[0].astype(f32)
+            g = jnp.zeros(n_pad, f32)
+            h = jnp.zeros(n_pad, f32)
+            for bk in bucket_args:
+                m = bk["idx"].shape[1]
+                sc_b = jnp.take(sc, bk["idx"])
+                lam, hes = bucket_lambdas(sc_b, bk["lab"], bk["val"],
+                                          bk["imd"], m)
+                lam = jnp.where(bk["val"], lam, 0.0)
+                hes = jnp.where(bk["val"], hes, 0.0)
+                g = g.at[bk["idx"].reshape(-1)].add(lam.reshape(-1))
+                h = h.at[bk["idx"].reshape(-1)].add(hes.reshape(-1))
+            if weight is not None:
+                g = g * weight
+                h = h * weight
+            return g[None, :], h[None, :]
+
+        jitted = jax.jit(grad_fn, static_argnames=())
+        return lambda scores, weight: jitted(scores, weight,
+                                             self._dev_buckets)
 
     def _one_query(self, qid, label, score):
         cnt = len(label)

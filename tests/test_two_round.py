@@ -145,3 +145,25 @@ def test_two_round_rejects_linear_tree(tmp_path):
     with pytest.raises(Exception):
         lgb.Dataset(path, params={"two_round": True,
                                   "linear_tree": True}).construct()
+
+
+def test_two_round_validation_set_streams(tmp_path):
+    """two_round applies to validation files too (aligned to the
+    training mappers, ref: LoadFromFileAlignWithOtherDataset): the eval
+    results must match the in-memory valid load."""
+    tr = str(tmp_path / "tr.tsv")
+    va = str(tmp_path / "va.tsv")
+    _write_file(tr, 4000, 6, seed=0)
+    _write_file(va, 2000, 6, seed=9)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "metric": "binary_logloss"}
+
+    def run(two):
+        ds = lgb.Dataset(tr, params={"two_round": two})
+        vs = lgb.Dataset(va, params={"two_round": two}, reference=ds)
+        rec = {}
+        lgb.train(p, ds, num_boost_round=5, valid_sets=[vs],
+                  callbacks=[lgb.record_evaluation(rec)])
+        return rec["valid_0"]["binary_logloss"]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-9)
