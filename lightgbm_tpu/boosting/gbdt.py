@@ -1317,8 +1317,65 @@ class GBDT:
 
     # ------------------------------------------------------------------- eval
     def eval_train(self):
+        if (isinstance(self.scores, jax.Array)
+                and not self.scores.is_fully_addressable):
+            return self._eval_train_sharded()
         score = np.asarray(self.scores)[:, :self.num_data].astype(np.float64)
         return self._eval(score, self.train_metrics, self.train_data)
+
+    def _eval_train_sharded(self):
+        """Train-set metrics under multi-process SPMD: the scores span
+        non-addressable devices, so each metric is computed as
+        shard-local partial sums that GSPMD all-reduces over the mesh —
+        every rank reads identical replicated scalars (the TPU analogue
+        of the reference workers' synchronized Eval in gbdt.cpp
+        EvalAndCheckEarlyStopping).  AUC uses a global score-bin
+        histogram (metric.py device_binned_auc)."""
+        from ..metric import device_binned_auc, device_pointwise_loss
+        if getattr(self, "_sharded_eval_fn", None) is None:
+            obj = self.objective
+            plans = []      # (metric_name, kind, loss_fn)
+            for m in self.train_metrics:
+                base = m.name
+                if self.num_tree_per_iteration > 1:
+                    log.warning(f"train metric {base} skipped under "
+                                "multi-process SPMD (multiclass scores "
+                                "not yet reduced on device)")
+                    continue
+                if base == "auc":
+                    plans.append((base, "auc", None))
+                    continue
+                fn = device_pointwise_loss(base, self.config)
+                if fn is None:
+                    log.warning(f"train metric {base} has no sharded "
+                                "device form; skipped under "
+                                "multi-process SPMD")
+                    continue
+                sqrt_after = base == "rmse"
+                plans.append((base, "sqrt" if sqrt_after else "avg", fn))
+            self._sharded_eval_plans = plans
+
+            def _fn(scores, label, weight, pad_mask):
+                sc = scores[0]
+                conv = (obj.convert_output(sc) if obj is not None
+                        and not getattr(obj, "run_on_host", False) else sc)
+                w = pad_mask if weight is None else weight * pad_mask
+                den = jnp.sum(w)
+                outs = []
+                for _, kind, fn in plans:
+                    if kind == "auc":
+                        outs.append(device_binned_auc(conv, label, w))
+                    else:
+                        v = jnp.sum(fn(conv, label) * w) / den
+                        outs.append(jnp.sqrt(v) if kind == "sqrt" else v)
+                return tuple(outs)
+
+            self._sharded_eval_fn = jax.jit(_fn)
+        vals = self._sharded_eval_fn(self.scores, self.label_dev,
+                                     self.weight_dev, self.pad_mask)
+        return [(name, float(v))
+                for (name, _, __), v in zip(self._sharded_eval_plans,
+                                            vals)]
 
     def eval_valid(self, idx: int):
         return self._eval(self.valid_scores[idx], self.valid_metrics[idx],

@@ -360,6 +360,11 @@ class Dataset:
             log.fatal("Empty data stream")
         sample = sample_buf[:filled]
         if reference is not None:
+            if sample.shape[1] != reference.num_total_features:
+                # same strictness as the in-memory valid path
+                # (construct_from_arrays)
+                log.fatal("Validation data feature count mismatch with "
+                          "reference Dataset")
             num_features = reference.num_total_features
         elif num_features is None:
             num_features = sample.shape[1]

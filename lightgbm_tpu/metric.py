@@ -420,3 +420,85 @@ def create_metrics(config: Config, for_objective: Optional[str] = None) -> List[
         seen.add(canon)
         out.append(_METRIC_CLASSES[canon](config))
     return out
+
+
+# ----------------------------------------------------- device (sharded) eval
+def device_pointwise_loss(name: str, config: Config):
+    """jnp pointwise-loss builder for the sharded train-metric evaluator
+    (gbdt._eval_train_sharded): fn(converted_score, label) -> loss, or
+    None when the metric has no device form.  Formulas mirror the host
+    classes above exactly (which mirror src/metric/*_metric.hpp)."""
+    import jax.numpy as jnp
+    eps10, eps15 = 1e-10, 1e-15
+
+    def clip_pos(s):
+        return jnp.maximum(s, eps10)
+
+    fns = {
+        "l2": lambda s, y: (s - y) ** 2,
+        "rmse": lambda s, y: (s - y) ** 2,          # sqrt after averaging
+        "l1": lambda s, y: jnp.abs(s - y),
+        "quantile": lambda s, y: jnp.where(
+            (y - s) < 0, (config.alpha - 1.0) * (y - s),
+            config.alpha * (y - s)),
+        "huber": lambda s, y: jnp.where(
+            jnp.abs(s - y) <= config.alpha,
+            0.5 * (s - y) ** 2,
+            config.alpha * (jnp.abs(s - y) - 0.5 * config.alpha)),
+        "fair": lambda s, y: (config.fair_c * jnp.abs(s - y)
+                              - config.fair_c ** 2
+                              * jnp.log1p(jnp.abs(s - y) / config.fair_c)),
+        "poisson": lambda s, y: clip_pos(s) - y * jnp.log(clip_pos(s)),
+        "mape": lambda s, y: jnp.abs((y - s)
+                                     / jnp.maximum(1.0, jnp.abs(y))),
+        "gamma": lambda s, y: (jnp.maximum(y, eps10) / clip_pos(s)
+                               + jnp.log(clip_pos(s))),
+        "gamma_deviance": lambda s, y: 2.0 * (
+            -jnp.log(jnp.maximum(y / clip_pos(s), eps10))
+            + y / clip_pos(s) - 1.0),
+        "tweedie": lambda s, y: (
+            -y * clip_pos(s) ** (1.0 - config.tweedie_variance_power)
+            / (1.0 - config.tweedie_variance_power)
+            + clip_pos(s) ** (2.0 - config.tweedie_variance_power)
+            / (2.0 - config.tweedie_variance_power)),
+        "binary_logloss": lambda s, y: jnp.where(
+            y > 0, -jnp.log(jnp.clip(s, eps15, 1 - eps15)),
+            -jnp.log(1.0 - jnp.clip(s, eps15, 1 - eps15))),
+        "binary_error": lambda s, y: ((s > 0.5) != (y > 0)).astype(
+            jnp.float32),
+        "xentropy": lambda s, y: -(y * jnp.log(jnp.clip(s, eps15, 1.0))
+                                   + (1.0 - y)
+                                   * jnp.log(jnp.clip(1.0 - s, eps15,
+                                                      1.0))),
+    }
+    return fns.get(name)
+
+
+def device_binned_auc(prob, label, w, num_bins: int = 16384):
+    """Weighted AUC from a global score-bin histogram — the
+    multi-process form (each term is a plain sum, so GSPMD reduces the
+    sharded rows with one all-reduce).  Resolution 1/num_bins of
+    probability space; ties within a bin get the same half-credit the
+    host block form gives exact ties (binary_metric.hpp:159)."""
+    import jax.numpy as jnp
+    # scores need not be probabilities (regression/ranking objectives
+    # report raw scores): min-max normalize over the weighted rows first
+    # — AUC is invariant under monotone maps, so this only sets the
+    # binning resolution.  Zero-weight (padding) rows are excluded from
+    # the range so they cannot skew it.
+    lo = jnp.min(jnp.where(w > 0, prob, jnp.inf))
+    hi = jnp.max(jnp.where(w > 0, prob, -jnp.inf))
+    span = jnp.maximum(hi - lo, 1e-30)
+    unit = jnp.clip((prob - lo) / span, 0.0, 1.0)
+    b = jnp.clip((unit * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    is_pos = label > 0
+    pos_h = jnp.zeros(num_bins, jnp.float32).at[b].add(
+        jnp.where(is_pos, w, 0.0))
+    neg_h = jnp.zeros(num_bins, jnp.float32).at[b].add(
+        jnp.where(is_pos, 0.0, w))
+    # descending-score accumulation: higher bins first
+    pos_above = (jnp.cumsum(pos_h[::-1])[::-1]) - pos_h
+    accum = jnp.sum(neg_h * (pos_above + 0.5 * pos_h))
+    tp, tn = jnp.sum(pos_h), jnp.sum(neg_h)
+    return jnp.where((tp == 0) | (tn == 0), 1.0, accum
+                     / jnp.maximum(tp * tn, 1e-30))

