@@ -93,6 +93,61 @@ class Dataset:
         self.used_indices: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
+    def _construct_from_sequences(self, seqs, cfg) -> "Dataset":
+        """Streamed (two-round) Sequence ingestion: batches are read
+        twice, bin codes are packed directly, and the concatenated float
+        matrix never exists (ref: the streaming push ingestion of
+        c_api.h:177-323 LGBM_DatasetPushRows)."""
+        from .io.dataset import Dataset as _CD
+        if cfg.linear_tree:
+            # same rejection as the file path (io/dataset.py): linear
+            # leaves need the raw values two_round exists to not hold
+            log.fatal("Cannot use two_round loading with linear tree")
+
+        def stream():
+            for seq in seqs:
+                n = len(seq)
+                bs = max(1, int(getattr(seq, "batch_size", 4096) or 4096))
+                for lo in range(0, n, bs):
+                    chunk = np.asarray(seq[lo:min(lo + bs, n)],
+                                       dtype=np.float64)
+                    yield chunk.reshape(chunk.shape[0], -1), None
+
+        names = (None if self.feature_name == "auto"
+                 else list(self.feature_name))
+        cat = []
+        if self.categorical_feature not in ("auto", None):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and names is not None:
+                    cat.append(names.index(c))
+                elif not isinstance(c, str):
+                    cat.append(int(c))
+                else:
+                    log.warning(f"categorical_feature {c!r} needs "
+                                "feature_name to resolve; ignored")
+        ref_core = (self.reference._core_or_construct()
+                    if self.reference else None)
+        self._core = _CD.construct_from_stream(
+            stream, weight=self.weight, group=self.group,
+            max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+            categorical_feature=cat, feature_names=names,
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            feature_pre_filter=cfg.feature_pre_filter,
+            seed=cfg.data_random_seed,
+            forcedbins_filename=cfg.forcedbins_filename,
+            reference=ref_core)
+        if self.label is not None:
+            self._core.metadata.set_label(self.label)
+        if self.init_score is not None:
+            self._core.metadata.set_init_score(self.init_score)
+        if self.position is not None:
+            self._core.metadata.set_position(self.position)
+        return self
+
+    # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
         if self._core is not None:
             return self
@@ -105,11 +160,21 @@ class Dataset:
                 self._core.metadata.set_label(self.label)
         else:
             data = self.data
+            seqs = None
             if isinstance(data, Sequence):
-                data = _materialize_sequences([data])
+                seqs = [data]
             elif (isinstance(data, list) and data
                     and all(isinstance(s, Sequence) for s in data)):
-                data = _materialize_sequences(data)
+                seqs = data
+            if seqs is not None and cfg.two_round:
+                # STREAMED Sequence ingestion (the incremental-push
+                # ingestion role of LGBM_DatasetPushRows,
+                # c_api.h:177-323): Sequences are random-access, so the
+                # two-round streaming constructor reads them twice in
+                # batches and the full float matrix never materializes
+                return self._construct_from_sequences(seqs, cfg)
+            if seqs is not None:
+                data = _materialize_sequences(seqs)
             # column names from pandas / arrow before coercion
             if self.feature_name == "auto":
                 if (type(data).__module__ or "").startswith("pyarrow") \

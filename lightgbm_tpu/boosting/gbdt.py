@@ -186,8 +186,16 @@ class GBDT:
             # instead hand-balances unequal feature subsets,
             # feature_parallel_tree_learner.cpp:30)
             F = self._n_device_cols
+            requested = n_mesh
             while n_mesh > 1 and F % n_mesh != 0:
                 n_mesh -= 1
+            if n_mesh != requested:
+                log.warning(
+                    f"tree_learner=feature: {F} feature columns have no "
+                    f"equal split over {requested} devices; using "
+                    f"{n_mesh} device(s) instead"
+                    + (" (feature parallelism DISABLED — consider "
+                       "tree_learner=data)" if n_mesh <= 1 else ""))
         if n_mesh <= 1:
             self._voting = False
             return None
@@ -1354,6 +1362,17 @@ class GBDT:
                 sqrt_after = base == "rmse"
                 plans.append((base, "sqrt" if sqrt_after else "avg", fn))
             self._sharded_eval_plans = plans
+            # metrics compare in ORIGINAL label space (the host path uses
+            # metadata.label): label_dev may be objective-transformed
+            # (reg_sqrt) or absent entirely (custom fobj), so build a
+            # dedicated sharded copy from the metadata
+            md = self.train_data.metadata
+            self._eval_label_dev = self._put_by_row(
+                _pad_rows(np.asarray(md.label, np.float32), self.n_pad))
+            self._eval_weight_dev = (
+                None if md.weight is None else self._put_by_row(
+                    _pad_rows(np.asarray(md.weight, np.float32),
+                              self.n_pad)))
 
             def _fn(scores, label, weight, pad_mask):
                 sc = scores[0]
@@ -1371,8 +1390,8 @@ class GBDT:
                 return tuple(outs)
 
             self._sharded_eval_fn = jax.jit(_fn)
-        vals = self._sharded_eval_fn(self.scores, self.label_dev,
-                                     self.weight_dev, self.pad_mask)
+        vals = self._sharded_eval_fn(self.scores, self._eval_label_dev,
+                                     self._eval_weight_dev, self.pad_mask)
         return [(name, float(v))
                 for (name, _, __), v in zip(self._sharded_eval_plans,
                                             vals)]

@@ -174,7 +174,25 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     label = np.asarray(core.metadata.label)
     rng = np.random.RandomState(seed)
 
-    if folds is None:
+    qb = core.metadata.query_boundaries
+    if folds is None and qb is not None:
+        # query-aware folds for ranking: whole queries go to one fold
+        # (ref: python-package engine.py _make_n_folds group branch —
+        # splitting inside a query would leak rank context across folds)
+        qb = np.asarray(qb)
+        nq = len(qb) - 1
+        if nq < nfold:
+            log.fatal(f"cv with ranking data needs >= nfold queries "
+                      f"(got {nq} queries, nfold={nfold})")
+        q_perm = np.arange(nq)
+        if shuffle:
+            rng.shuffle(q_perm)
+        fold_of_q = np.empty(nq, np.int64)
+        fold_of_q[q_perm] = np.arange(nq) % nfold
+        row_fold = np.repeat(fold_of_q, np.diff(qb))
+        folds = [(np.nonzero(row_fold != k)[0],
+                  np.nonzero(row_fold == k)[0]) for k in range(nfold)]
+    elif folds is None:
         idx = np.arange(n)
         if shuffle:
             rng.shuffle(idx)

@@ -360,9 +360,10 @@ class Dataset:
             log.fatal("Empty data stream")
         sample = sample_buf[:filled]
         if reference is not None:
-            if sample.shape[1] != reference.num_total_features:
-                # same strictness as the in-memory valid path
-                # (construct_from_arrays)
+            # wider than the training data is a real mismatch; NARROWER
+            # is legal for sparse LibSVM (trailing features all-zero in
+            # the validation file) and zero-pads below
+            if sample.shape[1] > reference.num_total_features:
                 log.fatal("Validation data feature count mismatch with "
                           "reference Dataset")
             num_features = reference.num_total_features

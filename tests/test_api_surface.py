@@ -190,3 +190,52 @@ def test_subset_mutators_rejected():
         sub.set_categorical_feature([0])
     with pytest.raises(lgb.LightGBMError, match="subset"):
         sub.add_features_from(lgb.Dataset(X[:3], free_raw_data=False))
+
+
+def test_sequence_two_round_streams_without_materializing():
+    """Sequence + two_round streams batches twice instead of
+    concatenating one big matrix (the LGBM_DatasetPushRows streaming
+    ingestion role, c_api.h:177-323): the trained model must equal the
+    materialized path's, and the concatenated matrix must never be
+    built."""
+    import lightgbm_tpu.basic as basic
+
+    X, y = _data(n=5000)
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 512
+
+        def __init__(self, arr):
+            self.arr = arr
+            self.reads = 0
+
+        def __getitem__(self, idx):
+            self.reads += 1
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    seq = ArrSeq(X)
+    calls = {"n": 0}
+    orig = basic._materialize_sequences
+
+    def counting(seqs):
+        calls["n"] += 1
+        return orig(seqs)
+    basic._materialize_sequences = counting
+    try:
+        b_stream = lgb.train(params, lgb.Dataset(
+            seq, label=y, params={"two_round": True}), num_boost_round=4)
+        assert calls["n"] == 0          # never materialized
+        assert seq.reads >= 2 * (5000 // 512)  # two streaming passes
+        b_mat = lgb.train(params, lgb.Dataset(ArrSeq(X), label=y),
+                          num_boost_round=4)
+        assert calls["n"] == 1          # default path still materializes
+    finally:
+        basic._materialize_sequences = orig
+    t_s = b_stream.model_to_string().split("\nparameters:")[0]
+    t_m = b_mat.model_to_string().split("\nparameters:")[0]
+    assert t_s == t_m

@@ -322,3 +322,28 @@ def test_feature_fraction_bynode():
     # by-node sampling spreads splits over more features
     imp = b1._gbdt.feature_importance("split")
     assert (imp > 0).sum() >= 4
+
+
+def test_cv_ranking_query_aware_folds():
+    """cv on ranking data assigns WHOLE queries to folds (ref:
+    python-package engine.py _make_n_folds group branch) — rows of one
+    query never straddle the train/valid split."""
+    rng = np.random.RandomState(0)
+    sizes = rng.randint(5, 30, size=40)
+    n = int(sizes.sum())
+    X = rng.rand(n, 5)
+    y = rng.randint(0, 4, n).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    res = lgb.cv({"objective": "lambdarank", "metric": "ndcg",
+                  "ndcg_eval_at": [3], "num_leaves": 7, "verbosity": -1,
+                  "min_data_in_leaf": 2}, ds, num_boost_round=3, nfold=4,
+                 return_cvbooster=True, seed=7)
+    assert "valid ndcg@3-mean" in res
+    cvb = res["cvbooster"]
+    total_queries = 0
+    for b in cvb.boosters:
+        qb = b._gbdt.train_data.metadata.query_boundaries
+        assert qb is not None          # group info survived the subset
+        total_queries += len(qb) - 1
+    # each of the 40 queries lands whole in exactly nfold-1 train folds
+    assert total_queries == 40 * 3

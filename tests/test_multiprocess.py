@@ -237,3 +237,34 @@ def test_multiprocess_train_eval_identical_and_correct(tmp_path):
     ref = dict(b._gbdt.eval_train())
     assert abs(ref["binary_logloss"] - r0["binary_logloss"]) < 2e-4
     assert abs(ref["auc"] - r0["auc"]) < 2e-3
+
+
+@pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
+                    reason="multiproc disabled")
+def test_programmatic_cluster_launcher(tmp_path):
+    """lightgbm_tpu.distributed.train_distributed — the reference
+    dask.py _train equivalent: spawn workers, train tree_learner=data
+    over the combined mesh, return the rank-0 Booster.  The distributed
+    model must match single-process training on the same data."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.distributed import train_distributed
+
+    rng = np.random.RandomState(3)
+    n = 4096
+    X = rng.rand(n, 6)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-4 * (X[:, 0] - 0.5)))
+         ).astype(np.float64)
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 5,
+              "tpu_growth_strategy": "leafwise"}
+    b_dist = train_distributed(
+        params, X, y, num_boost_round=4, num_machines=2,
+        force_cpu=True,
+        worker_env={"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    b_single = lgb.train({**params, "tree_learner": "serial"},
+                         lgb.Dataset(X, label=y), num_boost_round=4)
+    p_d = b_dist.predict(X[:512])
+    p_s = b_single.predict(X[:512])
+    np.testing.assert_allclose(p_d, p_s, rtol=2e-4, atol=2e-6)
