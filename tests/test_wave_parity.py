@@ -13,11 +13,11 @@ iters on the v5e chip — PERF_NOTES.md, round 4):
   wave, wave_prune=false        0.1199     0.72730
   wave (prune, overshoot 1.5)   0.1382     0.72873
   wave (prune, overshoot 2.0)   0.1877     0.72956
-  leafwise (parity engine)      5.04       0.73047
+  leafwise (parity engine)      0.958      0.73047
   reference CLI (same data)     0.2223 (1-core CPU) 0.73087
 
 The leafwise engine matches the reference oracle's quality; the default
-wave+prune engine trades a bounded AUC delta for ~35x speed.  This test
+wave+prune engine trades a bounded AUC delta for ~7x speed.  This test
 pins the bound at a CPU-tractable scale, asserts bit-exact leaf-wise
 equivalence under full coverage, and asserts the tail-halving option
 sits between plain wave and leafwise in budget allocation behavior.
