@@ -1346,9 +1346,14 @@ class GBDT:
             for m in self.train_metrics:
                 base = m.name
                 if self.num_tree_per_iteration > 1:
-                    log.warning(f"train metric {base} skipped under "
-                                "multi-process SPMD (multiclass scores "
-                                "not yet reduced on device)")
+                    # multiclass: per-row class probabilities from the
+                    # [K, n] scores, reduced the same sharded way
+                    if base in ("multi_logloss", "multi_error"):
+                        plans.append((base, base, None))
+                    else:
+                        log.warning(f"train metric {base} has no sharded "
+                                    "device form; skipped under "
+                                    "multi-process SPMD")
                     continue
                 if base == "auc":
                     plans.append((base, "auc", None))
@@ -1375,12 +1380,33 @@ class GBDT:
                               self.n_pad)))
 
             def _fn(scores, label, weight, pad_mask):
-                sc = scores[0]
-                conv = (obj.convert_output(sc) if obj is not None
-                        and not getattr(obj, "run_on_host", False) else sc)
                 w = pad_mask if weight is None else weight * pad_mask
                 den = jnp.sum(w)
                 outs = []
+                if self.num_tree_per_iteration > 1:
+                    # [K, n] -> per-class probabilities (softmax for
+                    # multiclass; ova objectives convert per class)
+                    prob = (obj.convert_output(scores) if obj is not None
+                            and not getattr(obj, "run_on_host", False)
+                            else scores)
+                    K = prob.shape[0]
+                    lab_oh = (label[None, :]
+                              == jnp.arange(K, dtype=prob.dtype)[:, None])
+                    p_lab = jnp.sum(jnp.where(lab_oh, prob, 0.0), axis=0)
+                    for _, kind, _fn2 in plans:
+                        if kind == "multi_logloss":
+                            pt = -jnp.log(jnp.clip(p_lab, 1e-15, 1.0))
+                        else:   # multi_error: true-class prob not in
+                            # top_k (strict ranks; ties count favorably,
+                            # mirroring MultiErrorMetric)
+                            rank = jnp.sum(prob > p_lab[None, :], axis=0)
+                            pt = (rank >= self.config.multi_error_top_k
+                                  ).astype(jnp.float32)
+                        outs.append(jnp.sum(pt * w) / den)
+                    return tuple(outs)
+                sc = scores[0]
+                conv = (obj.convert_output(sc) if obj is not None
+                        and not getattr(obj, "run_on_host", False) else sc)
                 for _, kind, fn in plans:
                     if kind == "auc":
                         outs.append(device_binned_auc(conv, label, w))

@@ -48,6 +48,36 @@ print(f"proc {pid} done", flush=True)
 """
 
 
+def _run_two_workers(tmp_path, worker_src, out_suffix, extra_args=()):
+    """Shared 2-process harness: free port, env strip, spawn, reap.
+    Returns (out_paths, logs); asserts both workers exited 0."""
+    import socket
+    script = tmp_path / "worker_h.py"
+    script.write_text(worker_src)
+    outs = [tmp_path / f"out_{i}.{out_suffix}" for i in range(2)]
+    with socket.socket() as sock:          # pick a free port per run
+        sock.bind(("localhost", 0))
+        port = str(sock.getsockname()[1])
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(outs[i]), port,
+         *map(str, extra_args)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo") for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out = "(timeout)\n" + (out or "")
+        logs.append(out)
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    return outs, logs
+
+
 @pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
                     reason="multiproc disabled")
 def test_two_process_training_identical_models(tmp_path):
@@ -199,24 +229,7 @@ def test_multiprocess_train_eval_identical_and_correct(tmp_path):
     same config (AUC via the global score-bin histogram, 1/16384
     resolution)."""
     import json
-    script = tmp_path / "eval_worker.py"
-    script.write_text(_EVAL_WORKER)
-    outs = [tmp_path / f"eval_{i}.json" for i in range(2)]
-    import socket
-    with socket.socket() as sock:
-        sock.bind(("localhost", 0))
-        port = str(sock.getsockname()[1])
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(i), str(outs[i]), port],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd="/root/repo") for i in range(2)]
-    logs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        logs.append(out)
-    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    outs, _ = _run_two_workers(tmp_path, _EVAL_WORKER, "json")
     r0 = json.loads(outs[0].read_text())
     r1 = json.loads(outs[1].read_text())
     assert r0 == r1, (r0, r1)
@@ -268,3 +281,65 @@ def test_programmatic_cluster_launcher(tmp_path):
     p_d = b_dist.predict(X[:512])
     p_s = b_single.predict(X[:512])
     np.testing.assert_allclose(p_d, p_s, rtol=2e-4, atol=2e-6)
+
+
+_MC_EVAL_WORKER = r"""
+import json, os, sys
+pid = int(sys.argv[1]); out_path = sys.argv[2]; port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(5)
+n = 3072
+X = rng.rand(n, 5)
+y = (X[:, 0] * 3 + X[:, 1]).astype(np.int64) % 3
+b = lgb.train({"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+               "verbosity": -1, "tree_learner": "data",
+               "metric": "multi_logloss,multi_error",
+               "tpu_growth_strategy": "leafwise", "min_data_in_leaf": 5},
+              lgb.Dataset(X, label=y.astype(np.float64)),
+              num_boost_round=3)
+res = b._gbdt.eval_train()
+with open(out_path, "w") as f:
+    json.dump({k: float(v) for k, v in res}, f)
+print(f"proc {pid} mc eval done", flush=True)
+"""
+
+
+@pytest.mark.skipif(bool(os.environ.get("LIGHTGBM_TPU_SKIP_MULTIPROC")),
+                    reason="multiproc disabled")
+def test_multiprocess_multiclass_train_eval(tmp_path):
+    """Multiclass train metrics reduce on device under multi-process
+    SPMD: identical on every rank, matching the single-process host
+    evaluation."""
+    import json
+    outs, _ = _run_two_workers(tmp_path, _MC_EVAL_WORKER, "json")
+    r0 = json.loads(outs[0].read_text())
+    r1 = json.loads(outs[1].read_text())
+    assert r0 == r1, (r0, r1)
+    assert set(r0) == {"multi_logloss", "multi_error"}
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    n = 3072
+    X = rng.rand(n, 5)
+    y = (X[:, 0] * 3 + X[:, 1]).astype(np.int64) % 3
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   "num_leaves": 7, "verbosity": -1,
+                   "metric": "multi_logloss,multi_error",
+                   "tpu_growth_strategy": "leafwise",
+                   "min_data_in_leaf": 5},
+                  lgb.Dataset(X, label=y.astype(np.float64)),
+                  num_boost_round=3)
+    ref = dict(b._gbdt.eval_train())
+    assert abs(ref["multi_logloss"] - r0["multi_logloss"]) < 2e-4
+    # models differ in leaf-value ulps; allow a few row flips
+    assert abs(ref["multi_error"] - r0["multi_error"]) < 5 / 3072
