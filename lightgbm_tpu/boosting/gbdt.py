@@ -1358,6 +1358,14 @@ class GBDT:
                 if base == "auc":
                     plans.append((base, "auc", None))
                     continue
+                if base == "ndcg":
+                    from ..metric import ndcg_device_plan
+                    bks, efn = ndcg_device_plan(
+                        m, self.n_pad,
+                        shared_buckets=getattr(obj, "_dev_buckets", None))
+                    self._ndcg_buckets = bks
+                    plans.append((base, "ndcg", (efn, list(m.eval_at))))
+                    continue
                 fn = device_pointwise_loss(base, self.config)
                 if fn is None:
                     log.warning(f"train metric {base} has no sharded "
@@ -1379,7 +1387,7 @@ class GBDT:
                     _pad_rows(np.asarray(md.weight, np.float32),
                               self.n_pad)))
 
-            def _fn(scores, label, weight, pad_mask):
+            def _fn(scores, label, weight, pad_mask, ndcg_buckets):
                 w = pad_mask if weight is None else weight * pad_mask
                 den = jnp.sum(w)
                 outs = []
@@ -1410,6 +1418,11 @@ class GBDT:
                 for _, kind, fn in plans:
                     if kind == "auc":
                         outs.append(device_binned_auc(conv, label, w))
+                    elif kind == "ndcg":
+                        # per-query partials from the raw scores (ndcg is
+                        # rank-based; conversion is monotone) — one value
+                        # per eval_at k
+                        outs.append(fn[0](sc, ndcg_buckets))
                     else:
                         v = jnp.sum(fn(conv, label) * w) / den
                         outs.append(jnp.sqrt(v) if kind == "sqrt" else v)
@@ -1417,10 +1430,16 @@ class GBDT:
 
             self._sharded_eval_fn = jax.jit(_fn)
         vals = self._sharded_eval_fn(self.scores, self._eval_label_dev,
-                                     self._eval_weight_dev, self.pad_mask)
-        return [(name, float(v))
-                for (name, _, __), v in zip(self._sharded_eval_plans,
-                                            vals)]
+                                     self._eval_weight_dev, self.pad_mask,
+                                     getattr(self, "_ndcg_buckets", []))
+        out = []
+        for (name, kind, extra), v in zip(self._sharded_eval_plans, vals):
+            if kind == "ndcg":
+                out.extend((f"ndcg@{k}", float(v[ki]))
+                           for ki, k in enumerate(extra[1]))
+            else:
+                out.append((name, float(v)))
+        return out
 
     def eval_valid(self, idx: int):
         return self._eval(self.valid_scores[idx], self.valid_metrics[idx],

@@ -502,3 +502,91 @@ def device_binned_auc(prob, label, w, num_bins: int = 16384):
     tp, tn = jnp.sum(pos_h), jnp.sum(neg_h)
     return jnp.where((tp == 0) | (tn == 0), 1.0, accum
                      / jnp.maximum(tp * tn, 1e-30))
+
+
+def bucket_queries(query_boundaries, n_pad: int):
+    """Group queries by pow2-padded length for device-side per-query
+    tensor programs (ranking gradients and ndcg eval share this):
+    returns a list of dicts {qs: [query ids], idx: [Qb, m] int32 global
+    row indices (padding -> n_pad - 1), val: [Qb, m] bool}."""
+    qb = np.asarray(query_boundaries)
+    lens = np.diff(qb).astype(np.int64)
+    groups = {}
+    for q, ln in enumerate(lens):
+        m = max(8, 1 << int(ln - 1).bit_length())
+        groups.setdefault(m, []).append(q)
+    out = []
+    for m, qs in sorted(groups.items()):
+        Qb = len(qs)
+        idx = np.full((Qb, m), n_pad - 1, np.int32)
+        val = np.zeros((Qb, m), bool)
+        for r, q in enumerate(qs):
+            a, b = int(qb[q]), int(qb[q + 1])
+            idx[r, :b - a] = np.arange(a, b)
+            val[r, :b - a] = True
+        out.append({"qs": qs, "m": m, "idx": idx, "val": val})
+    return out
+
+
+def ndcg_device_plan(metric: "NDCGMetric", n_pad: int,
+                     shared_buckets=None):
+    """Device evaluation plan for NDCG@k over sharded scores: per-query
+    DCG from bucketed sort programs, ideal DCG precomputed host-side
+    (labels are static).  Returns (bucket_args pytree, eval_fn) where
+    eval_fn(scores_1d, bucket_args) -> [len(eval_at)] means — the
+    multi-process form of NDCGMetric.eval (rank_metric.hpp:20)."""
+    import jax.numpy as jnp
+    gains_np = np.asarray(metric.label_gain, np.float64)
+    lab_all = metric.label.astype(np.int64)
+    ks = list(metric.eval_at)
+    buckets = []
+    nq = 0
+    for bi, b in enumerate(bucket_queries(metric.query_boundaries, n_pad)):
+        Qb, m = len(b["qs"]), b["m"]
+        g = np.zeros((Qb, m), np.float32)
+        idcg = np.zeros((Qb, len(ks)), np.float32)
+        disc = 1.0 / np.log2(np.arange(m) + 2.0)
+        for r, q in enumerate(b["qs"]):
+            a, e = (int(metric.query_boundaries[q]),
+                    int(metric.query_boundaries[q + 1]))
+            gq = gains_np[lab_all[a:e]]
+            ideal = np.sort(gq)[::-1]
+            g[r, :e - a] = gq
+            for ki, k in enumerate(ks):
+                kk = min(k, e - a)
+                idcg[r, ki] = (ideal[:kk] * disc[:kk]).sum()
+        # a lambdarank objective has already uploaded identical idx/val
+        # tensors (bucket_queries is deterministic) — share them instead
+        # of holding a second device copy
+        sh = (shared_buckets[bi] if shared_buckets is not None
+              and bi < len(shared_buckets)
+              and shared_buckets[bi]["idx"].shape == b["idx"].shape
+              else None)
+        buckets.append({"idx": sh["idx"] if sh else jnp.asarray(b["idx"]),
+                        "val": sh["val"] if sh else jnp.asarray(b["val"]),
+                        "g": jnp.asarray(g),
+                        "idcg": jnp.asarray(idcg)})
+        nq += Qb
+
+    def eval_fn(sc, bucket_args):
+        sums = jnp.zeros(len(ks), jnp.float32)
+        for bk in bucket_args:
+            m = bk["idx"].shape[1]
+            scb = jnp.take(sc, bk["idx"])
+            key = jnp.where(bk["val"], scb, -jnp.inf)
+            order = jnp.argsort(-key, axis=1, stable=True)
+            g_sorted = jnp.take_along_axis(bk["g"], order, 1)
+            disc = (1.0 / jnp.log2(
+                jnp.arange(m, dtype=jnp.float32) + 2.0))
+            terms = []
+            for ki, k in enumerate(ks):
+                kk = min(k, m)
+                dcg = jnp.sum(g_sorted[:, :kk] * disc[None, :kk], axis=1)
+                nd = jnp.where(bk["idcg"][:, ki] > 0,
+                               dcg / jnp.maximum(bk["idcg"][:, ki], 1e-30),
+                               1.0)
+                terms.append(jnp.sum(nd))
+            sums = sums + jnp.stack(terms)
+        return sums / nq
+
+    return buckets, eval_fn

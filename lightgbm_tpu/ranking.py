@@ -148,28 +148,20 @@ class LambdarankNDCG(RankingObjective):
         import jax
         import jax.numpy as jnp
 
+        from .metric import bucket_queries
         qb = self.query_boundaries
-        lens = np.diff(qb).astype(np.int64)
-        buckets = {}
-        for q, ln in enumerate(lens):
-            m = max(8, 1 << int(ln - 1).bit_length())
-            buckets.setdefault(m, []).append(q)
         self._dev_buckets = []
-        for m, qs in sorted(buckets.items()):
-            Qb = len(qs)
-            idx = np.full((Qb, m), n_pad - 1, np.int32)
+        for b in bucket_queries(qb, n_pad):
+            Qb, m = len(b["qs"]), b["m"]
             lab = np.zeros((Qb, m), np.int32)
-            val = np.zeros((Qb, m), bool)
             imd = np.zeros(Qb, np.float32)
-            for r, q in enumerate(qs):
-                a, b = int(qb[q]), int(qb[q + 1])
-                idx[r, :b - a] = np.arange(a, b)
-                lab[r, :b - a] = self.label[a:b].astype(np.int32)
-                val[r, :b - a] = True
+            for r, q in enumerate(b["qs"]):
+                a, e = int(qb[q]), int(qb[q + 1])
+                lab[r, :e - a] = self.label[a:e].astype(np.int32)
                 imd[r] = self.inverse_max_dcgs[q]
             self._dev_buckets.append(dict(
-                m=m, idx=jnp.asarray(idx), lab=jnp.asarray(lab),
-                val=jnp.asarray(val), imd=jnp.asarray(imd)))
+                m=m, idx=jnp.asarray(b["idx"]), lab=jnp.asarray(lab),
+                val=jnp.asarray(b["val"]), imd=jnp.asarray(imd)))
         lg = jnp.asarray(self.label_gain, jnp.float32)
         sigmoid, norm, trunc = self.sigmoid, self.norm, self.truncation_level
         f32 = jnp.float32
