@@ -847,9 +847,9 @@ class GBDT:
         obj = self.objective
         if getattr(obj, "run_on_host", False):
             # ranking objectives with a device program (bucketed pairwise
-            # lambdas, ranking.py make_device_grad_fn) skip the
-            # host round-trip entirely; the per-query host loop remains
-            # for the position-bias mode and rank_xendcg
+            # lambdas + on-device position-bias Newton state, ranking.py
+            # make_device_grad_fn) skip the host round-trip entirely;
+            # the per-query host loop remains for rank_xendcg
             dev_fn = getattr(self, "_ranking_dev_fn", None)
             if dev_fn is None and hasattr(obj, "make_device_grad_fn"):
                 dev_fn = obj.make_device_grad_fn(self.n_pad)

@@ -57,6 +57,23 @@ class RankingObjective(ObjectiveFunction):
             self.num_position_ids = int(self.positions.max()) + 1
             self.pos_biases = np.zeros(self.num_position_ids)
 
+    @property
+    def pos_biases(self):
+        """Learned per-position offsets.  When the device gradient
+        program is active the Newton state lives on device
+        (_pos_biases_dev); reading here pulls it to host lazily."""
+        dev = getattr(self, "_pos_biases_dev", None)
+        if dev is not None:
+            return np.asarray(dev, np.float64)
+        return self._pos_biases_host
+
+    @pos_biases.setter
+    def pos_biases(self, v):
+        # a host write takes over: drop the device snapshot so reads
+        # and the host Newton loop stay coherent (re-init, host path)
+        self._pos_biases_dev = None
+        self._pos_biases_host = v
+
     def get_gradients_host(self, score: np.ndarray):
         """score [n] -> (grad, hess) on host (ref: RankingObjective::GetGradients)."""
         n = len(score)
@@ -105,8 +122,9 @@ class LambdarankNDCG(RankingObjective):
     lambdas as one masked [Qb, T, m] tensor program (the TPU analogue of
     the per-query CUDA kernels in cuda_rank_objective.cu:131
     GetGradientsKernel_LambdarankNDCG), and results scatter back through
-    the precomputed doc-index map.  The host per-query loop remains as
-    the fallback for position bias (its Newton state is host-side)."""
+    the precomputed doc-index map; position-bias offsets and their
+    Newton update run on device too, the bias vector threaded as
+    explicit state."""
     name = "lambdarank"
 
     def __init__(self, config: Config):
@@ -135,16 +153,19 @@ class LambdarankNDCG(RankingObjective):
 
     # ------------------------------------------------------------------
     def make_device_grad_fn(self, n_pad: int):
-        """Build the jitted device gradient program, or None when the
-        host path must run (position bias carries host Newton state).
+        """Build the jitted device gradient program (always available
+        for lambdarank; position-bias mode included).
 
         Bucket tensors (doc indices, labels, valid masks, 1/maxDCG) are
         passed as explicit jit arguments — closing over large device
         arrays embeds them as constants, which degrades every subsequent
         dispatch on the remote-TPU runtime (see gbdt.py _grad_fn note).
-        """
-        if self.positions is not None:
-            return None
+
+        Position bias (ref: rank_objective.hpp:43-60,290) also runs on
+        device: scores are offset by the per-position biases before the
+        pairwise lambdas, and the Newton bias update is computed from
+        the weighted lambdas/hessians via segment sums — the bias vector
+        rides as explicit state threaded through each call."""
         import jax
         import jax.numpy as jnp
 
@@ -222,8 +243,30 @@ class LambdarankNDCG(RankingObjective):
             hes = jnp.take_along_axis(hes_s, inv_order, 1)
             return lam, hes
 
-        def grad_fn(scores, weight, bucket_args):
+        use_pos = self.positions is not None
+        if use_pos:
+            P = self.num_position_ids
+            pos_dev = jnp.asarray(
+                np.concatenate([self.positions.astype(np.int32),
+                                np.zeros(n_pad - len(self.positions),
+                                         np.int32)]))
+            pos_mask = jnp.asarray(
+                np.concatenate([np.ones(len(self.positions), np.float32),
+                                np.zeros(n_pad - len(self.positions),
+                                         np.float32)]))
+            # per-position doc counts are static: precompute host-side
+            # instead of a scatter-add every iteration
+            pos_cnt = jnp.asarray(np.bincount(
+                self.positions, minlength=P).astype(np.float32))
+            self._pos_biases_dev = jnp.zeros(P, f32)
+            lr = self.learning_rate
+            reg = self.position_bias_regularization
+
+        def grad_fn(scores, weight, bucket_args, biases, pos_dev,
+                    pos_mask, pos_cnt):
             sc = scores[0].astype(f32)
+            if use_pos:
+                sc = sc + jnp.take(biases, pos_dev)     # hpp:68
             g = jnp.zeros(n_pad, f32)
             h = jnp.zeros(n_pad, f32)
             for bk in bucket_args:
@@ -238,11 +281,34 @@ class LambdarankNDCG(RankingObjective):
             if weight is not None:
                 g = g * weight
                 h = h * weight
-            return g[None, :], h[None, :]
+            if use_pos:
+                # Newton step on the per-position utility derivatives
+                # (ref: rank_objective.hpp:290 UpdatePositionBiasFactors),
+                # from the WEIGHTED lambdas like the host path
+                fd = -(jnp.zeros(P, f32).at[pos_dev].add(g * pos_mask))
+                sd = -(jnp.zeros(P, f32).at[pos_dev].add(h * pos_mask))
+                fd = fd - biases * reg * pos_cnt
+                sd = sd - reg * pos_cnt
+                biases = biases + lr * fd / (jnp.abs(sd) + 0.001)
+            return g[None, :], h[None, :], biases
 
         jitted = jax.jit(grad_fn, static_argnames=())
-        return lambda scores, weight: jitted(scores, weight,
-                                             self._dev_buckets)
+        zero1 = jnp.zeros(1, f32)
+        zeroi = jnp.zeros(1, jnp.int32)
+        if not use_pos:
+            def call(scores, weight):
+                g, h, _ = jitted(scores, weight, self._dev_buckets,
+                                 zero1, zeroi, zero1, zero1)
+                return g, h
+            return call
+
+        def call(scores, weight):
+            g, h, nb = jitted(scores, weight, self._dev_buckets,
+                              self._pos_biases_dev, pos_dev, pos_mask,
+                              pos_cnt)
+            self._pos_biases_dev = nb
+            return g, h
+        return call
 
     def _one_query(self, qid, label, score):
         cnt = len(label)

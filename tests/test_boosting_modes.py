@@ -378,3 +378,46 @@ def test_lambdarank_device_vs_host_training_close():
     # fp32 device vs fp64 host lambdas: trees may diverge late; scores
     # must stay close in aggregate
     assert np.corrcoef(p_d, p_h)[0, 1] > 0.999, np.corrcoef(p_d, p_h)
+
+
+def test_lambdarank_position_bias_device_matches_host():
+    """Position-bias mode also runs on device: per-iteration gradients
+    AND the Newton bias state must track the host loop across several
+    iterations (the bias feeds back into the next iteration's scores)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.ranking import LambdarankNDCG
+
+    rng = np.random.RandomState(4)
+    lens = [5, 9, 17, 33, 12, 24]
+    n = sum(lens)
+    md = Metadata(n)
+    md.set_label(rng.randint(0, 5, n).astype(np.float64))
+    md.set_group(np.asarray(lens, np.int64))
+    md.set_position(rng.randint(0, 10, n).astype(np.int64))
+
+    obj_h = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj_h.init(md, n)
+    obj_d = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj_d.init(md, n)
+    n_pad = 128
+    fn = obj_d.make_device_grad_fn(n_pad)
+    assert fn is not None       # position bias no longer forces host
+
+    score = rng.randn(n)
+    for it in range(3):
+        g_h, h_h = obj_h.get_gradients_host(score.copy())
+        sc = jnp.zeros((1, n_pad)).at[0, :n].set(
+            jnp.asarray(score, jnp.float32))
+        g_d, h_d = fn(sc, None)
+        np.testing.assert_allclose(np.asarray(g_d[0, :n]), g_h,
+                                   rtol=3e-3, atol=3e-4,
+                                   err_msg=f"iter {it} grad")
+        np.testing.assert_allclose(np.asarray(h_d[0, :n]), h_h,
+                                   rtol=3e-3, atol=3e-4,
+                                   err_msg=f"iter {it} hess")
+        np.testing.assert_allclose(np.asarray(obj_d._pos_biases_dev),
+                                   obj_h.pos_biases, rtol=2e-3,
+                                   atol=2e-4, err_msg=f"iter {it} bias")
+        score = score * 0.9 + 0.1 * rng.randn(n)   # evolve scores
