@@ -275,6 +275,13 @@ class GBDT:
                              train_data.max_num_bin - 1) <= 255 else np.int32
         self._n_device_cols = binned.shape[0]
         self.mesh = self._make_training_mesh(config)
+        if self.mesh is not None and self._mesh_axis == 1:
+            # sharded rows: each device's local shard must itself be a
+            # _PAD multiple (the sharded-wave Pallas kernel tiles local
+            # rows; shard_map sees only the shard) — pad the global row
+            # count to _PAD * mesh_size
+            m = _PAD * int(self.mesh.devices.size)
+            self.n_pad = (n + m - 1) // m * m
         if self._voting and train_data.pre_bundled_plan is not None:
             # the PV-Tree vote is per-feature; bundle codes from sparse
             # ingestion cannot vote — run the plain data-parallel
@@ -451,11 +458,13 @@ class GBDT:
                 monotone_intermediate=False)
         if self.mesh is not None and self._mesh_axis == 1:
             # row sharding: masked engine (global-index row gathers would
-            # all-gather the binned matrix) + XLA histogram (GSPMD cannot
-            # partition a pallas_call without shard_map)
+            # all-gather the binned matrix).  The wave engine keeps its
+            # Pallas histogram and runs under explicit shard_map (the
+            # sharded-wave selection below); only the leaf-wise engine,
+            # which rides GSPMD annotations, downgrades to the XLA
+            # segment histogram (GSPMD cannot partition a pallas_call).
             from ..parallel import grow_params_for_mesh
-            self.grow_params = grow_params_for_mesh(
-                self.grow_params)._replace(hist_method="segment")
+            self.grow_params = grow_params_for_mesh(self.grow_params)
             if self._voting:
                 # PV-Tree vote (ref: voting_parallel_tree_learner.cpp):
                 # children rebuilt per scan (elected feature sets differ
@@ -563,7 +572,23 @@ class GBDT:
                         "histogram falls back to the XLA one-hot wave "
                         "histogram, which materializes [F, n, B] — only "
                         "viable for small datasets")
-        self._grow_fn = grow_tree_wave if strategy == "wave" else grow_tree
+        if strategy == "wave" and (self.mesh is not None
+                                   and self._mesh_axis == 1
+                                   and self.grow_params.voting is None):
+            # data-parallel wave: the DEFAULT engine sharded over the row
+            # mesh via shard_map + histogram psum (the reference's
+            # ReduceScatter path, data_parallel_tree_learner.cpp:282)
+            from ..parallel import make_sharded_wave_fn
+            self._grow_fn = make_sharded_wave_fn(self.mesh)
+        elif strategy == "wave":
+            self._grow_fn = grow_tree_wave
+        else:
+            if self.mesh is not None and self._mesh_axis == 1:
+                # leaf-wise under a row mesh rides GSPMD annotations,
+                # which cannot partition a pallas_call
+                self.grow_params = self.grow_params._replace(
+                    hist_method="segment")
+            self._grow_fn = grow_tree
         self.growth_strategy = strategy
 
         # scores [K, n_pad] on device

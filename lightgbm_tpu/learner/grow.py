@@ -133,6 +133,14 @@ class GrowParams(NamedTuple):
     # rects instead of the intermediate mode's whole-leaf scalar.
     # Requires monotone_intermediate.
     monotone_advanced: bool = False
+    # data-parallel mesh axis name when the engine runs INSIDE
+    # jax.shard_map over sharded rows (parallel/data_parallel.py
+    # make_sharded_wave_fn): every row-axis reduction (histograms, root
+    # sums, exact counts) is followed by a psum over this axis — the XLA
+    # collective replacing the reference's Network::ReduceScatter of
+    # histograms (ref: data_parallel_tree_learner.cpp:282-295).  None in
+    # single-device / GSPMD-annotated runs.
+    data_axis: object = None
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",

@@ -103,6 +103,18 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     use_pallas = params.hist_method == "pallas"
 
+    # Under shard_map (parallel/data_parallel.py) rows are the local shard:
+    # every row-axis reduction is completed by a psum over the data axis —
+    # the same computed-slot histogram reduction the reference's
+    # distributed learner performs with Network::ReduceScatter
+    # (ref: data_parallel_tree_learner.cpp:282-295).  All other state
+    # (tree arrays, caches, gain scan) is replicated, so the bookkeeping
+    # needs no synchronization — the reference's SyncUpGlobalBestSplit
+    # (:441) becomes a no-op by construction.
+    def _psum(x):
+        return (jax.lax.psum(x, params.data_axis)
+                if params.data_axis is not None else x)
+
     use_int8 = (use_pallas and params.quant_bins > 0
                 and quant_scales is not None)
 
@@ -134,22 +146,27 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 # quantized grid grads -> exact int32 accumulation through
                 # the MXU int8 path (ref: dense_bin.hpp:174
                 # ConstructHistogramIntInner)
-                return build_histogram_wave(
+                H, cnt = build_histogram_wave(
                     binned, kslot, ghm, max_bin=hist_B,
                     num_slots=num_slots, quant_bins=params.quant_bins,
                     quant_scales=quant_scales)
-            if (true_slots is not None and binned_rm is not None
+            elif (true_slots is not None and binned_rm is not None
                     and wave_hl_profitable(hist_B, true_slots)
                     and _hl_fits(true_slots)):
-                return build_histogram_wave_hl(
+                H, cnt = build_histogram_wave_hl(
                     binned, binned_rm, kslot, ghm, max_bin=hist_B,
                     num_slots=true_slots, out_slots=num_slots)
-            # Rt stays 512: 1024 is ~3% faster on small slot counts but
-            # exceeds the 16 MB scoped-VMEM limit at 128 computed slots
-            return build_histogram_wave(binned, kslot, ghm,
-                                        max_bin=hist_B, num_slots=num_slots)
-        return _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
-                              num_slots=num_slots)
+            else:
+                # Rt stays 512: 1024 is ~3% faster on small slot counts
+                # but exceeds the 16 MB scoped-VMEM limit at 128 slots
+                H, cnt = build_histogram_wave(binned, kslot, ghm,
+                                              max_bin=hist_B,
+                                              num_slots=num_slots)
+        else:
+            H, cnt = _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
+                                    num_slots=num_slots)
+        # shard-local histograms -> global (psum is a no-op single-device)
+        return _psum(H), _psum(cnt)
 
     if sp.extra_trees:
         _extra_key = jax.random.PRNGKey(sp.extra_seed)
@@ -249,9 +266,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if (use_bynode or use_interaction)
                                 else None))
 
-    sum_g0 = jnp.sum(grad)
-    sum_h0 = jnp.sum(hess)
-    cnt0 = jnp.sum(row_mask).astype(i32)
+    sum_g0 = _psum(jnp.sum(grad))
+    sum_h0 = _psum(jnp.sum(hess))
+    cnt0 = _psum(jnp.sum(row_mask)).astype(i32)
 
     # overgrow-and-prune quality mode (see GrowParams.wave_prune): the
     # ladder grows to Lg > L leaves, then the leaf-wise pop order is
@@ -839,9 +856,9 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # cnt_leaf_data): per-old-slot masked counts from one extra MXU
         # column, scattered through the [Lp] slot->leaf table — no second
         # [n, Lp] one-hot pass
-        cnt_slot = jax.lax.dot_general(
+        cnt_slot = _psum(jax.lax.dot_general(
             row_mask.astype(jnp.bfloat16)[None, :], ohr,
-            (((1,), (0,)), ((), ())), preferred_element_type=f32)[0]
+            (((1,), (0,)), ((), ())), preferred_element_type=f32)[0])
         exact = jnp.zeros(Lp, f32).at[lid_map].add(cnt_slot).astype(i32)
         tree_f = tree_f._replace(leaf_count=exact)
         return tree_f, leaf_id_f
@@ -856,10 +873,10 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # fp32 accumulator holds integer sums < 2^24 exactly.
         oh = (leaf_id[:, None] ==
               jnp.arange(Lp, dtype=i32)[None, :]).astype(jnp.bfloat16)
-        exact = jax.lax.dot_general(
+        exact = _psum(jax.lax.dot_general(
             row_mask.astype(jnp.bfloat16)[None, :], oh,
             (((1,), (0,)), ((), ())),
-            preferred_element_type=f32)[0].astype(i32)
+            preferred_element_type=f32)[0]).astype(i32)
         tree = tree._replace(leaf_count=exact)
     if Lp != L:  # back to the caller-visible [L] leaf layout
         tree = tree._replace(

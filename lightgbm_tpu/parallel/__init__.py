@@ -9,9 +9,11 @@ and XLA inserts the psum/all_gather collectives over ICI/DCN.
 """
 
 from .binning import merged_bin_mappers, sample_rows
-from .data_parallel import (data_parallel_shardings, grow_params_for_mesh, make_mesh,
+from .data_parallel import (data_parallel_shardings, grow_params_for_mesh,
+                            make_mesh, make_sharded_wave_fn,
                             shard_for_data_parallel)
 
 __all__ = [
-    "merged_bin_mappers", "sample_rows","data_parallel_shardings", "grow_params_for_mesh", "make_mesh",
-           "shard_for_data_parallel"]
+    "merged_bin_mappers", "sample_rows", "data_parallel_shardings",
+    "grow_params_for_mesh", "make_mesh", "make_sharded_wave_fn",
+    "shard_for_data_parallel"]

@@ -60,6 +60,61 @@ def grow_params_for_mesh(params):
     return params._replace(compact_min=0)
 
 
+def make_sharded_wave_fn(mesh: Mesh):
+    """Wave engine under explicit jax.shard_map over the data axis — the
+    DEFAULT (Pallas) engine's distributed form.
+
+    GSPMD cannot partition a pallas_call, so annotation-only sharding had
+    to fall back to the leaf-wise/segment engine.  shard_map instead runs
+    the per-shard Pallas histogram kernel on each device's local rows and
+    the engine psums the computed-slot histograms (wave.py `_psum`) —
+    exactly the reference's ReduceScatter of the same histograms its
+    serial learner computes (ref: data_parallel_tree_learner.cpp:282-295
+    HistogramSumReducer; :441 SyncUpGlobalBestSplit is a no-op here
+    because the gain scan runs replicated on the reduced histograms).
+
+    Returns a callable with the `_grow_fn` signature
+    (binned, grad, hess, row_mask, col_mask, meta, params, **kw);
+    jit-compiled once per (params, extra-kw-set) pair.
+    """
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def _build(params, keys):
+        from ..learner.wave import grow_tree_wave
+        sh_params = params._replace(data_axis=DATA_AXIS)
+
+        def inner(binned, grad, hess, row_mask, col_mask, meta, *extras):
+            return grow_tree_wave(binned, grad, hess, row_mask, col_mask,
+                                  meta, sh_params, **dict(zip(keys, extras)))
+
+        ax = DATA_AXIS
+        # tree arrays replicated (every shard computes identical
+        # bookkeeping from the psum'd histograms); leaf_id stays sharded.
+        # check_vma off: replication of the tree outputs is by
+        # construction (all inputs to the bookkeeping are psum results),
+        # which the static checker cannot see through the Pallas calls.
+        return jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P())
+            + (P(),) * len(keys),
+            out_specs=(P(), P(ax)),
+            check_vma=False))
+
+    def call(binned, grad, hess, row_mask, col_mask, meta, params,
+             cegb_used=None, extra_tag=None, quant_scales=None):
+        opt = (("cegb_used", cegb_used), ("extra_tag", extra_tag),
+               ("quant_scales", quant_scales))
+        keys = tuple(k for k, v in opt if v is not None)
+        extras = tuple(v for _, v in opt if v is not None)
+        import jax.numpy as jnp
+        extras = tuple(jnp.asarray(e) for e in extras)
+        return _build(params, keys)(binned, grad, hess, row_mask,
+                                    col_mask, meta, *extras)
+
+    return call
+
+
 def data_parallel_shardings(mesh: Mesh) -> Tuple:
     """(binned, per-row vectors, replicated) shardings for grow_tree args."""
     row = NamedSharding(mesh, P(DATA_AXIS))

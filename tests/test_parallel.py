@@ -226,3 +226,121 @@ def test_distributed_binning_matches_global():
         bins = m.values_to_bins(Xfull[:, f])
         assert bins.max() < m.num_bin
         assert len(np.unique(bins)) > 30
+
+
+def test_sharded_wave_engine_matches_unsharded():
+    """The WAVE engine (the default/Pallas engine's growth loop) executed
+    under shard_map over the 8-device mesh must produce the identical tree
+    and row partition as single-device wave: the per-shard histograms are
+    psum'd exactly like the reference's ReduceScatter of its serial
+    learner's histograms (data_parallel_tree_learner.cpp:282-295)."""
+    from lightgbm_tpu.learner.wave import grow_tree_wave
+    from lightgbm_tpu.parallel import make_sharded_wave_fn
+
+    X, y, binned = _problem(n=8192)
+    F, n = binned.shape
+    B, L = 32, 15
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.full(F, MISSING_NONE, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    params = GrowParams(num_leaves=L, max_bin=B,
+                        split=SplitParams(min_data_in_leaf=5))
+    t_ref, leaf_ref = grow_tree_wave(
+        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32), jnp.ones(F, bool), meta, params)
+
+    mesh = make_mesh(8)
+    by_row, row, _ = data_parallel_shardings(mesh)
+    fn = make_sharded_wave_fn(mesh)
+    t_sh, leaf_sh = fn(jax.device_put(binned, by_row),
+                       jax.device_put(grad, row),
+                       jax.device_put(hess, row),
+                       jax.device_put(np.ones(n, np.float32), row),
+                       jnp.asarray(np.ones(F, bool)), meta, params)
+    ref, sh = _tree_fields(t_ref), _tree_fields(t_sh)
+    assert int(ref["num_leaves"]) == int(sh["num_leaves"]) > 1
+    for k in ("split_feature", "threshold_bin", "left_child", "right_child",
+              "leaf_count", "internal_count", "default_left", "leaf_parent",
+              "leaf_depth"):
+        np.testing.assert_array_equal(ref[k], sh[k], err_msg=k)
+    for k in ("leaf_value", "leaf_weight", "split_gain", "internal_value"):
+        np.testing.assert_allclose(ref[k], sh[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
+    np.testing.assert_array_equal(np.asarray(leaf_ref), np.asarray(leaf_sh))
+
+
+def test_sharded_wave_prune_matches_unsharded():
+    """Same invariant with the overgrow-and-prune quality mode on (the
+    bench default): the prune replay runs replicated on psum'd gains and
+    the final exact counts ride a psum."""
+    from lightgbm_tpu.learner.wave import grow_tree_wave
+    from lightgbm_tpu.parallel import make_sharded_wave_fn
+
+    X, y, binned = _problem(n=8192, seed=7)
+    F, n = binned.shape
+    B, L = 32, 15
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.full(F, MISSING_NONE, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    params = GrowParams(num_leaves=L, max_bin=B, wave_prune=True,
+                        split=SplitParams(min_data_in_leaf=5))
+    t_ref, leaf_ref = grow_tree_wave(
+        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32), jnp.ones(F, bool), meta, params)
+    mesh = make_mesh(8)
+    by_row, row, _ = data_parallel_shardings(mesh)
+    fn = make_sharded_wave_fn(mesh)
+    t_sh, leaf_sh = fn(jax.device_put(binned, by_row),
+                       jax.device_put(grad, row),
+                       jax.device_put(hess, row),
+                       jax.device_put(np.ones(n, np.float32), row),
+                       jnp.asarray(np.ones(F, bool)), meta, params)
+    ref, sh = _tree_fields(t_ref), _tree_fields(t_sh)
+    for k in ("num_leaves", "split_feature", "threshold_bin", "leaf_count",
+              "internal_count"):
+        np.testing.assert_array_equal(ref[k], sh[k], err_msg=k)
+    np.testing.assert_array_equal(np.asarray(leaf_ref), np.asarray(leaf_sh))
+
+
+def test_data_parallel_wave_training_identical_model():
+    """tree_learner=data with the WAVE engine on the 8-device mesh == serial
+    wave training: structurally identical trees (psum reduction order may
+    shift float payloads by ulps, so floats compare to tolerance)."""
+    X, y, _ = _problem(n=4096)
+
+    def train(extra):
+        p = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+             "min_data_in_leaf": 5, "learning_rate": 0.2,
+             "tpu_growth_strategy": "wave"}
+        p.update(extra)
+        return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+
+    b_s = train({"tree_learner": "serial"})
+    b_d = train({"tree_learner": "data"})
+    g = b_d._gbdt
+    assert g.mesh is not None and g.mesh.devices.size == 8
+    assert g.growth_strategy == "wave"
+    # the default engine must NOT have been downgraded under the mesh
+    assert g.grow_params.hist_method != "segment" or \
+        jax.default_backend() != "tpu"
+    b_s._gbdt._drain_pending(keep_depth=0)
+    g._drain_pending(keep_depth=0)
+    ts, td = b_s._gbdt.models_, g.models_
+    assert len(ts) == len(td)
+    for a, b in zip(ts, td):
+        assert a.num_leaves == b.num_leaves
+        np.testing.assert_array_equal(a.split_feature, b.split_feature)
+        np.testing.assert_array_equal(a.threshold_in_bin, b.threshold_in_bin)
+        np.testing.assert_array_equal(a.leaf_count, b.leaf_count)
+        np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(b_d.predict(X), b_s.predict(X),
+                               rtol=1e-4, atol=1e-6)
