@@ -13,6 +13,7 @@ num_data, so sec_per_iter_baseline ~ 0.260 * rows / 10.5e6.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -90,6 +91,19 @@ def main():
     # be bought with broken trees)
     auc = _auc(yte, booster._gbdt.predict_raw(Xte))
 
+    # kernel-correctness gate (tools/kernel_checks.py): the Pallas kernel
+    # unit tests skip off-TPU, so the driver's chip run is the only CI
+    # that executes them — carry a pass/fail field every round
+    kernel_checks = "skipped"
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools.kernel_checks import run_checks
+            kernel_checks = run_checks()
+    except Exception as e:  # noqa: BLE001 - the gate must not kill bench
+        kernel_checks = f"error:{type(e).__name__}"
+
     baseline = BASELINE_SEC_PER_ITER_10M * ROWS / HIGGS_ROWS
     out = {
         "metric": f"higgs_like_{ROWS//1000}k_binary_255leaves_sec_per_iter",
@@ -98,6 +112,7 @@ def main():
         "vs_baseline": round(baseline / elapsed, 4),
         "auc": round(auc, 5),
         "iters_trained": WARMUP + ITERS,
+        "kernel_checks": kernel_checks,
     }
     # measured-oracle anchor (tools/bench_oracle.py): the REAL reference
     # CLI trained on this same dataset on this host — pins the target AUC
@@ -129,6 +144,8 @@ def main():
     for fname, prefix, keys in (
             ("bench_10m.json", "b10m_",
              ("sec_per_iter", "auc", "iters", "vs_baseline_28core_2015",
+              "setup_s", "e2e_500iter_s",
+              "e2e_500iter_vs_baseline_28core_2015",
               "useful_mac_mfu", "measured_at")),
             ("oracle_bench_10m.json", "b10m_ref_",
              ("ref_sec_per_iter", "ref_auc_at_iters", "host_cpus"))):

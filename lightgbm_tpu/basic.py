@@ -23,7 +23,10 @@ from .utils import log
 
 
 def _coerce_matrix(data) -> np.ndarray:
-    """pandas / pyarrow / scipy-sparse / array-like -> float64 ndarray."""
+    """pandas / pyarrow / scipy-sparse / array-like -> float ndarray.
+    float32 passes through unconverted: binning treats it per column, and
+    large float32 matrices take the exact device bucketize path
+    (io/device_bin.py) instead of a host float64 pass."""
     if (type(data).__module__ or "").startswith("pyarrow"):
         return np.column_stack([
             np.asarray(data.column(i).to_numpy(zero_copy_only=False),
@@ -33,6 +36,9 @@ def _coerce_matrix(data) -> np.ndarray:
         data = data.values
     if hasattr(data, "toarray"):         # scipy CSR/CSC/COO
         data = data.toarray()
+    data = np.asarray(data)
+    if data.dtype == np.float32:
+        return data
     return np.asarray(data, dtype=np.float64)
 
 
@@ -137,6 +143,7 @@ class Dataset:
             zero_as_missing=cfg.zero_as_missing,
             feature_pre_filter=cfg.feature_pre_filter,
             seed=cfg.data_random_seed,
+            max_bin_by_feature=cfg.max_bin_by_feature or None,
             forcedbins_filename=cfg.forcedbins_filename,
             reference=ref_core)
         if self.label is not None:

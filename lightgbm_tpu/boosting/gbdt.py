@@ -263,11 +263,21 @@ class GBDT:
         elif (config.enable_bundle and train_data.num_features > 1
                 and not voting_engages):
             from ..io.bundle import build_bundled, plan_bundles
-            plan = plan_bundles(binned, train_data.bin_mappers,
+            plan_src = binned
+            if isinstance(binned, jax.Array):
+                # device-binned: plan from host bins of the construction
+                # sample (gathering sample columns through the remote
+                # tunnel costs ~1000x more)
+                plan_src = train_data.efb_sample_bins()
+                if plan_src is None:
+                    plan_src = train_data.binned_host()
+            plan = plan_bundles(plan_src, train_data.bin_mappers,
                                 train_data.used_features,
                                 max_conflict_rate=config.max_conflict_rate)
             if plan.effective:
                 self.bundle_plan = plan
+                if isinstance(binned, jax.Array):
+                    binned = train_data.binned_host()
                 binned = build_bundled(binned, plan)
                 log.info(f"EFB bundled {len(plan.group_idx)} features into "
                          f"{plan.num_groups} columns")
@@ -290,9 +300,31 @@ class GBDT:
                         "sparse pre-bundled datasets fall back to "
                         "data-parallel histogram reduction")
             self._voting = False
-        self.binned_dev = self._put_by_row(
-            _pad_rows(binned.astype(dtype), self.n_pad), axis=1,
-            is_binned=True)
+        if isinstance(binned, jax.Array) and self.mesh is None:
+            # device-binned dataset (io/device_bin.py): pad on device —
+            # the 280MB-class bin matrix never makes a host round-trip.
+            # The unpadded buffer is DONATED so only one device copy
+            # stays resident; the dataset keeps a view descriptor for
+            # lazy host recovery (binned_host)
+            pad = self.n_pad - binned.shape[1]
+            n_true = binned.shape[1]
+            if pad == 0:
+                bd = binned
+            else:
+                bd = jnp.pad(binned, ((0, 0), (0, pad)))
+                # drop the unpadded device copy — the dataset recovers a
+                # host view lazily through _binned_view when needed
+                train_data.binned = None
+            self.binned_dev = (bd if bd.dtype == dtype
+                               else bd.astype(dtype))
+            train_data._binned_view = (self.binned_dev, n_true)
+        else:
+            if isinstance(binned, jax.Array):
+                binned = train_data.binned_host()   # mesh placement is
+                # host-driven (_put_by_row shards the host copy)
+            self.binned_dev = self._put_by_row(
+                _pad_rows(binned.astype(dtype), self.n_pad), axis=1,
+                is_binned=True)
         self.pad_mask = self._put_by_row(
             _pad_rows(np.ones(n, np.float32), self.n_pad))
 
@@ -872,9 +904,10 @@ class GBDT:
         obj = self.objective
         if getattr(obj, "run_on_host", False):
             # ranking objectives with a device program (bucketed pairwise
-            # lambdas + on-device position-bias Newton state, ranking.py
-            # make_device_grad_fn) skip the host round-trip entirely;
-            # the per-query host loop remains for rank_xendcg
+            # lambdas / masked-softmax passes + on-device position-bias
+            # Newton state, ranking.py make_device_grad_fn) skip the host
+            # round-trip entirely; the per-query host loop remains only
+            # for position-bias rank_xendcg and custom objectives
             dev_fn = getattr(self, "_ranking_dev_fn", None)
             if dev_fn is None and hasattr(obj, "make_device_grad_fn"):
                 dev_fn = obj.make_device_grad_fn(self.n_pad)
@@ -1309,7 +1342,7 @@ class GBDT:
         sparse-ingested data) bundle codes with its own plan."""
         from ..models.tree import K_CATEGORICAL_MASK
         ni = tree.num_leaves - 1
-        binned = ds.binned
+        binned = ds.binned_host()
         plan = ds.pre_bundled_plan
         bundle_kw = {}
         if plan is not None:
@@ -1375,6 +1408,11 @@ class GBDT:
                     # [K, n] scores, reduced the same sharded way
                     if base in ("multi_logloss", "multi_error"):
                         plans.append((base, base, None))
+                    elif base == "auc_mu":
+                        # pairwise-projection binned AUCs (metric.py
+                        # device_auc_mu); the weight matrix is static
+                        plans.append((base, "auc_mu",
+                                      np.asarray(m.class_weights)))
                     else:
                         log.warning(f"train metric {base} has no sharded "
                                     "device form; skipped under "
@@ -1383,6 +1421,9 @@ class GBDT:
                 if base == "auc":
                     plans.append((base, "auc", None))
                     continue
+                if base == "average_precision":
+                    plans.append((base, "average_precision", None))
+                    continue
                 if base == "ndcg":
                     from ..metric import ndcg_device_plan
                     bks, efn = ndcg_device_plan(
@@ -1390,6 +1431,14 @@ class GBDT:
                         shared_buckets=getattr(obj, "_dev_buckets", None))
                     self._ndcg_buckets = bks
                     plans.append((base, "ndcg", (efn, list(m.eval_at))))
+                    continue
+                if base == "map":
+                    from ..metric import map_device_plan
+                    bks, efn = map_device_plan(
+                        m, self.n_pad,
+                        shared_buckets=getattr(obj, "_dev_buckets", None))
+                    self._map_buckets = bks
+                    plans.append((base, "map", (efn, list(m.eval_at))))
                     continue
                 fn = device_pointwise_loss(base, self.config)
                 if fn is None:
@@ -1412,7 +1461,10 @@ class GBDT:
                     _pad_rows(np.asarray(md.weight, np.float32),
                               self.n_pad)))
 
-            def _fn(scores, label, weight, pad_mask, ndcg_buckets):
+            def _fn(scores, label, weight, pad_mask, ndcg_buckets,
+                    map_buckets):
+                from ..metric import (device_auc_mu,
+                                      device_binned_average_precision)
                 w = pad_mask if weight is None else weight * pad_mask
                 den = jnp.sum(w)
                 outs = []
@@ -1426,14 +1478,22 @@ class GBDT:
                     lab_oh = (label[None, :]
                               == jnp.arange(K, dtype=prob.dtype)[:, None])
                     p_lab = jnp.sum(jnp.where(lab_oh, prob, 0.0), axis=0)
-                    for _, kind, _fn2 in plans:
+                    for _, kind, extra in plans:
                         if kind == "multi_logloss":
                             pt = -jnp.log(jnp.clip(p_lab, 1e-15, 1.0))
+                        elif kind == "auc_mu":
+                            # pairwise projections are of RAW scores
+                            # (multiclass_metric.hpp:255 uses score)
+                            outs.append(device_auc_mu(
+                                scores, label, w, extra))
+                            continue
                         else:   # multi_error: true-class prob not in
-                            # top_k (strict ranks; ties count favorably,
-                            # mirroring MultiErrorMetric)
-                            rank = jnp.sum(prob > p_lab[None, :], axis=0)
-                            pt = (rank >= self.config.multi_error_top_k
+                            # top_k; ties count AGAINST the row (ref:
+                            # multiclass_metric.hpp:142 LossOnPoint
+                            # counts >= incl. self, error when > top_k)
+                            num_ge = jnp.sum(prob >= p_lab[None, :],
+                                             axis=0)
+                            pt = (num_ge > self.config.multi_error_top_k
                                   ).astype(jnp.float32)
                         outs.append(jnp.sum(pt * w) / den)
                     return tuple(outs)
@@ -1443,11 +1503,16 @@ class GBDT:
                 for _, kind, fn in plans:
                     if kind == "auc":
                         outs.append(device_binned_auc(conv, label, w))
+                    elif kind == "average_precision":
+                        outs.append(device_binned_average_precision(
+                            conv, label, w))
                     elif kind == "ndcg":
                         # per-query partials from the raw scores (ndcg is
                         # rank-based; conversion is monotone) — one value
                         # per eval_at k
                         outs.append(fn[0](sc, ndcg_buckets))
+                    elif kind == "map":
+                        outs.append(fn[0](sc, map_buckets))
                     else:
                         v = jnp.sum(fn(conv, label) * w) / den
                         outs.append(jnp.sqrt(v) if kind == "sqrt" else v)
@@ -1456,11 +1521,12 @@ class GBDT:
             self._sharded_eval_fn = jax.jit(_fn)
         vals = self._sharded_eval_fn(self.scores, self._eval_label_dev,
                                      self._eval_weight_dev, self.pad_mask,
-                                     getattr(self, "_ndcg_buckets", []))
+                                     getattr(self, "_ndcg_buckets", []),
+                                     getattr(self, "_map_buckets", []))
         out = []
         for (name, kind, extra), v in zip(self._sharded_eval_plans, vals):
-            if kind == "ndcg":
-                out.extend((f"ndcg@{k}", float(v[ki]))
+            if kind in ("ndcg", "map"):
+                out.extend((f"{name}@{k}", float(v[ki]))
                            for ki, k in enumerate(extra[1]))
             else:
                 out.append((name, float(v)))

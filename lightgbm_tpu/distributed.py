@@ -31,6 +31,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -167,20 +168,30 @@ def _train_distributed_in(work, port, params, data, label, weight, group,
         f.write(_WORKER_MAIN)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # worker output goes to files, not PIPEs: a chatty later-rank worker
+    # filling the ~64KB pipe buffer while an earlier rank still trains
+    # would block inside a collective and stall every rank until timeout
+    log_paths = [os.path.join(work, f"worker_{r}.log")
+                 for r in range(num_machines)]
+    log_files = [open(p, "w") for p in log_paths]
     procs = [subprocess.Popen([sys.executable, script, spec_path, str(r)],
-                              stdout=subprocess.PIPE,
+                              stdout=log_files[r],
                               stderr=subprocess.STDOUT, text=True, env=env)
              for r in range(num_machines)]
     logs = []
     ok = True
-    for p in procs:
+    deadline = time.monotonic() + timeout
+    for r, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=timeout)
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            prefix = ""
         except subprocess.TimeoutExpired:
             p.kill()
-            out, _ = p.communicate()   # reap + collect partial output
-            out = "(timeout)\n" + (out or "")
-        logs.append(out)
+            p.wait()
+            prefix = "(timeout)\n"
+        log_files[r].close()
+        with open(log_paths[r]) as f:
+            logs.append(prefix + f.read())
         ok = ok and p.returncode == 0
     if not ok or not os.path.exists(model_out):
         log.fatal("distributed training failed:\n" + "\n".join(logs))

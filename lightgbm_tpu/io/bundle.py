@@ -135,7 +135,9 @@ def plan_bundles(binned: np.ndarray, mappers, used_features,
     zb = _default_bins(mappers, used_features)
     sample = (np.arange(n) if n <= _SAMPLE else
               (rng or np.random.RandomState(3)).choice(n, _SAMPLE, False))
-    sub = binned[:, sample]
+    # device-binned datasets (io/device_bin.py): gather the row sample on
+    # device, pull only the [F, S] slice
+    sub = np.asarray(binned[:, sample])
     nz = sub != zb[:, None]                       # [F, S] non-default mask
     nbins = np.array([mappers[f].num_bin for f in used_features], np.int32)
     return plan_bundles_from_masks(nz, nbins, zb, len(sample),

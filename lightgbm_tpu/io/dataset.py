@@ -122,7 +122,11 @@ class Dataset:
         self.bin_mappers: List[BinMapper] = []          # per original feature
         self.used_feature_map: List[int] = []            # original -> inner (-1 trivial)
         self.used_features: List[int] = []               # inner -> original
-        self.binned: Optional[np.ndarray] = None         # int32 [F_used, n]
+        # [F_used, n] bin codes: host int32/uint8, or a DEVICE jax.Array
+        # (uint8) when the device second pass ran (io/device_bin.py) — the
+        # training path consumes it on device without a host round-trip;
+        # host-only paths call binned_host()
+        self.binned = None
         self.metadata: Optional[Metadata] = None
         self.max_bin: int = 255
         self.raw_data: Optional[np.ndarray] = None       # kept for linear trees
@@ -130,6 +134,16 @@ class Dataset:
         # holds [num_bundles, n] EFB bundle codes instead of per-feature
         # bins, and this BundlePlan decodes them
         self.pre_bundled_plan = None
+        # raw (float32) bin-construction sample rows, kept when `binned`
+        # lives on device: EFB planning bins them lazily host-side
+        # (efb_sample_bins) instead of gathering sample columns through
+        # the device tunnel
+        self._efb_sample_raw: Optional[np.ndarray] = None
+        self._efb_sample_bins: Optional[np.ndarray] = None
+        # (binned_dev_padded, n): set by the booster when it takes over
+        # the device bin matrix (padded, donated) so binned_host() can
+        # still recover the [F, n] host view without a duplicate copy
+        self._binned_view = None
 
     # ------------------------------------------------------------------
     @property
@@ -148,6 +162,31 @@ class Dataset:
     def inner_feature_index(self, original: int) -> int:
         return self.used_feature_map[original]
 
+    def binned_host(self) -> np.ndarray:
+        """Host view of the bin matrix; pulls (once) when the device
+        second pass left it on device (or the booster holds the padded
+        device matrix after taking it over)."""
+        if self.binned is None and self._binned_view is not None:
+            from .device_bin import pull_host
+            arr, n = self._binned_view
+            self.binned = pull_host(arr)[:, :n]
+        if self.binned is not None and not isinstance(self.binned,
+                                                      np.ndarray):
+            from .device_bin import pull_host
+            self.binned = pull_host(self.binned)
+        return self.binned
+
+    def efb_sample_bins(self) -> Optional[np.ndarray]:
+        """Host [F_used, S] bin codes of the bin-construction sample
+        (EFB planning input for device-binned datasets); binned lazily
+        and cached."""
+        if self._efb_sample_bins is None and self._efb_sample_raw is not None:
+            self._efb_sample_bins = np.stack([
+                self.bin_mappers[f].values_to_bins(
+                    np.asarray(self._efb_sample_raw[:, i], np.float64))
+                for i, f in enumerate(self.used_features)])
+        return self._efb_sample_bins
+
     def feature_bins(self, inner: int) -> np.ndarray:
         """Per-feature bin codes [n]; decodes bundle-space storage on
         demand for sparse-ingested datasets (the bundle member's code
@@ -155,10 +194,10 @@ class Dataset:
         host-side mirror of Dataset::FixHistogram's member recovery)."""
         plan = self.pre_bundled_plan
         if plan is None:
-            return self.binned[inner]
+            return self.binned_host()[inner]
         g = int(plan.group_idx[inner])
         off = int(plan.offsets[inner])
-        col = self.binned[g].astype(np.int32)
+        col = self.binned_host()[g].astype(np.int32)
         if off == 0:                     # singleton bundle: codes ARE bins
             return col
         local = col - off
@@ -195,7 +234,13 @@ class Dataset:
         When `reference` is given, reuse its bin mappers (validation-set path,
         ref: basic.py create_valid / LoadFromFileAlignWithOtherDataset).
         """
-        data = np.asarray(data, dtype=np.float64)
+        # keep the caller's dtype: values_to_bins converts per column, and
+        # float32 inputs take the exact device bucketize path (the host is
+        # single-core; ref does this pass in parallel C++,
+        # dataset_loader.cpp:246 ExtractFeaturesFromMemory)
+        data = np.asarray(data)
+        if data.dtype not in (np.float32, np.float64):
+            data = data.astype(np.float64)
         if data.ndim != 2:
             log.fatal("Training data must be 2-dimensional")
         n, num_features = data.shape
@@ -234,11 +279,29 @@ class Dataset:
                 max_bin_by_feature=max_bin_by_feature,
                 forcedbins_filename=forcedbins_filename)
 
-        # bin every used feature (ref: ExtractFeaturesFromMemory PushOneRow)
-        binned = np.empty((len(ds.used_features), n), dtype=np.int32)
-        for inner, f in enumerate(ds.used_features):
-            binned[inner] = ds.bin_mappers[f].values_to_bins(data[:, f])
-        ds.binned = binned
+        # bin every used feature (ref: ExtractFeaturesFromMemory PushOneRow).
+        # float32 large-n numeric data bucketizes on device in one compiled
+        # pass (io/device_bin.py, exact); otherwise the host searchsorted
+        # loop runs per feature.
+        from .device_bin import bin_matrix_device, device_binnable
+        if device_binnable(ds.bin_mappers, ds.used_features, data.dtype, n):
+            ds.binned = bin_matrix_device(data, ds.bin_mappers,
+                                          ds.used_features)
+            if reference is None:
+                # keep the (already-sampled) bin-finding rows: EFB
+                # planning bins them lazily on first request
+                # (efb_sample_bins) — gathering sample columns out of
+                # the device matrix costs ~1000x more (tunnel gather),
+                # and eager binning would waste ~2s when bundling is off
+                ds._efb_sample_raw = np.ascontiguousarray(
+                    sample[:, ds.used_features]
+                    if sample.shape[1] != len(ds.used_features)
+                    else sample)
+        else:
+            binned = np.empty((len(ds.used_features), n), dtype=np.int32)
+            for inner, f in enumerate(ds.used_features):
+                binned[inner] = ds.bin_mappers[f].values_to_bins(data[:, f])
+            ds.binned = binned
 
         md = Metadata(n)
         if label is not None:
@@ -248,7 +311,9 @@ class Dataset:
         md.set_init_score(init_score)
         ds.metadata = md
         if keep_raw_data:
-            ds.raw_data = data
+            # linear-tree solves expect float64 raw values regardless of
+            # the input dtype
+            ds.raw_data = np.asarray(data, np.float64)
         return ds
 
     # ------------------------------------------------------------------
@@ -455,7 +520,7 @@ class Dataset:
         sub.used_feature_map = self.used_feature_map
         sub.used_features = self.used_features
         sub.max_bin = self.max_bin
-        sub.binned = self.binned[:, used_indices]
+        sub.binned = self.binned_host()[:, used_indices]
         sub.pre_bundled_plan = self.pre_bundled_plan
         md = Metadata(sub.num_data)
         src = self.metadata
@@ -493,7 +558,7 @@ class Dataset:
         md = self.metadata
         np.savez_compressed(
             path,
-            binned=self.binned,
+            binned=self.binned_host(),
             label=md.label,
             weight=md.weight if md.weight is not None else np.array([]),
             init_score=md.init_score if md.init_score is not None else np.array([]),
@@ -638,6 +703,7 @@ def _load_two_round(path: str, cfg, reference: Optional[Dataset] = None
         zero_as_missing=cfg.zero_as_missing,
         feature_pre_filter=cfg.feature_pre_filter,
         seed=cfg.data_random_seed,
+        max_bin_by_feature=cfg.max_bin_by_feature or None,
         forcedbins_filename=cfg.forcedbins_filename,
         reference=reference)
 

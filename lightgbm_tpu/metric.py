@@ -236,10 +236,118 @@ class MultiErrorMetric(Metric):
         top_k = self.config.multi_error_top_k
         li = self.label.astype(np.int64)
         true_p = prob[li, np.arange(prob.shape[1])]
-        # error if true-class prob is not within top_k (ties count favorably)
-        rank = (prob > true_p[None, :]).sum(axis=0)
-        err = (rank >= top_k).astype(np.float64)
+        # error if true-class prob is not within top_k; ties count AGAINST
+        # the row (ref: multiclass_metric.hpp:142 LossOnPoint counts
+        # num_larger with >= including the class itself, error when
+        # num_larger > top_k)
+        num_ge = (prob >= true_p[None, :]).sum(axis=0)
+        err = (num_ge > top_k).astype(np.float64)
         return [(self.name, self._avg(err))]
+
+
+class AucMuMetric(Metric):
+    """AUC-mu multiclass ranking metric (ref: multiclass_metric.hpp:183
+    AucMuMetric; Kleiman & Page, ICML'19).  For every class pair (i, j)
+    the rows of the two classes are projected on the separating direction
+    v = W[i] - W[j] (W the auc_mu weight matrix, default all-ones with
+    zero diagonal, config.cpp:220) and a pairwise AUC S[i][j] is
+    accumulated with the reference's tie handling: rows within kEpsilon
+    (1e-15, meta.h:54) of the last j-class distance contribute 0.5 per
+    tied j row.  Result = 2 * sum_{i<j} S[i][j]/(n_i*n_j) / (K*(K-1))."""
+    name = "auc_mu"
+    is_higher_better = True
+    _EPS = 1e-15
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        K = self.config.num_class
+        w = list(self.config.auc_mu_weights or [])
+        if w:
+            if len(w) != K * K:
+                log.fatal(f"auc_mu_weights must have {K * K} elements, "
+                          f"but found {len(w)}")
+            W = np.asarray(w, np.float64).reshape(K, K)
+            if np.abs(np.diag(W)).max() > 1e-35:
+                log.info("AUC-mu matrix must have zeros on diagonal. "
+                         "Overwriting.")
+            np.fill_diagonal(W, 0.0)
+        else:
+            W = np.ones((K, K), np.float64)
+            np.fill_diagonal(W, 0.0)
+        self.class_weights = W
+        li = self.label.astype(np.int64)
+        self.class_idx = [np.nonzero(li == k)[0] for k in range(K)]
+        self.class_sizes = np.array([len(ix) for ix in self.class_idx])
+        if self.weight is not None:
+            self.class_data_weights = np.array(
+                [float(self.weight[ix].sum()) for ix in self.class_idx])
+
+    def _pair_auc(self, score, i, j):
+        """S[i][j] of the reference's Eval loop, vectorized."""
+        idx = np.concatenate([self.class_idx[i], self.class_idx[j]])
+        if len(self.class_idx[i]) == 0 or len(self.class_idx[j]) == 0:
+            return 0.0
+        v = self.class_weights[i] - self.class_weights[j]      # curr_v
+        t1 = v[i] - v[j]
+        dist = t1 * (v @ score[:, idx])                        # [n_i+n_j]
+        lab = np.concatenate([np.full(len(self.class_idx[i]), i),
+                              np.full(len(self.class_idx[j]), j)])
+        w = (np.ones(len(idx)) if self.weight is None
+             else self.weight[idx])
+        # sort by distance; exact ties put class j first (the reference
+        # comparator orders near-ties by label descending; exact-tie
+        # grouping below covers the epsilon credit)
+        order = np.lexsort((-lab, dist))
+        dist, lab, w = dist[order], lab[order], w[order]
+        is_j = lab == j
+        wj = np.where(is_j, w, 0.0)
+        cum_wj = np.cumsum(wj)                 # num_j including position
+        # j-distance groups: a new group starts when the j row's distance
+        # moves >= eps from the previous j row's (the reference chains
+        # from the group-start distance; consecutive chaining is
+        # equivalent except for pathological sub-eps ladders)
+        jpos = np.nonzero(is_j)[0]
+        if len(jpos) == 0:
+            return 0.0
+        jd = dist[jpos]
+        new_grp = np.empty(len(jpos), bool)
+        new_grp[0] = True
+        new_grp[1:] = np.abs(np.diff(jd)) >= self._EPS
+        grp_of_j = np.cumsum(new_grp) - 1
+        starts = np.nonzero(new_grp)[0]
+        grp_start_dist = jd[starts]
+        grp_start_cumwj_before = cum_wj[jpos[starts]] - wj[jpos[starts]]
+        # per row: index of the last j row at/before it
+        last_j = np.searchsorted(jpos, np.arange(len(dist)), "right") - 1
+        ipos = np.nonzero(~is_j)[0]
+        li_ = last_j[ipos]
+        has_j = li_ >= 0
+        g = grp_of_j[np.maximum(li_, 0)]
+        num_j_before = np.where(has_j, cum_wj[np.maximum(jpos[np.maximum(
+            li_, 0)], 0)], 0.0) * has_j
+        tie = has_j & (np.abs(dist[ipos] - grp_start_dist[g]) < self._EPS)
+        num_cur_j = np.where(tie, num_j_before
+                             - grp_start_cumwj_before[g], 0.0)
+        contrib = w[ipos] * (num_j_before - 0.5 * num_cur_j)
+        return float(contrib.sum())
+
+    def eval(self, score, objective=None):
+        K = self.config.num_class
+        if score.ndim == 1:
+            score = score.reshape(K, -1)
+        score = np.asarray(score, np.float64)
+        ans = 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                s = self._pair_auc(score, i, j)
+                if self.weight is None:
+                    den = (self.class_sizes[i] * self.class_sizes[j])
+                else:
+                    den = (self.class_data_weights[i]
+                           * self.class_data_weights[j])
+                if den > 0:
+                    ans += s / den
+        return [(self.name, 2.0 * ans / (K * (K - 1)))]
 
 
 # --------------------------------------------------------------------- ranking
@@ -366,7 +474,7 @@ _METRIC_ALIASES = {
     "auc": "auc", "average_precision": "average_precision",
     "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
     "softmax": "multi_logloss", "multiclassova": "multi_logloss",
-    "multi_error": "multi_error",
+    "multi_error": "multi_error", "auc_mu": "auc_mu",
     "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
     "xendcg": "ndcg", "map": "map", "mean_average_precision": "map",
     "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
@@ -382,6 +490,7 @@ _METRIC_CLASSES = {
     "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
     "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
     "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
     "ndcg": NDCGMetric, "map": MapMetric,
     "cross_entropy": CrossEntropyMetric,
     "cross_entropy_lambda": CrossEntropyLambdaMetric,
@@ -502,6 +611,113 @@ def device_binned_auc(prob, label, w, num_bins: int = 16384):
     tp, tn = jnp.sum(pos_h), jnp.sum(neg_h)
     return jnp.where((tp == 0) | (tn == 0), 1.0, accum
                      / jnp.maximum(tp * tn, 1e-30))
+
+
+def device_binned_average_precision(prob, label, w, num_bins: int = 16384):
+    """Weighted average precision from the same global score-bin
+    histogram device_binned_auc uses (multi-process form of
+    binary_metric.hpp AveragePrecisionMetric).  Within-bin ordering is
+    quantized to 1/num_bins of score space, like the binned AUC."""
+    import jax.numpy as jnp
+    lo = jnp.min(jnp.where(w > 0, prob, jnp.inf))
+    hi = jnp.max(jnp.where(w > 0, prob, -jnp.inf))
+    span = jnp.maximum(hi - lo, 1e-30)
+    unit = jnp.clip((prob - lo) / span, 0.0, 1.0)
+    b = jnp.clip((unit * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    is_pos = label > 0
+    pos_h = jnp.zeros(num_bins, jnp.float32).at[b].add(
+        jnp.where(is_pos, w, 0.0))
+    neg_h = jnp.zeros(num_bins, jnp.float32).at[b].add(
+        jnp.where(is_pos, 0.0, w))
+    # descending-score traversal: inclusive cumulative tp/fp from above
+    tp = jnp.cumsum(pos_h[::-1])[::-1]
+    fp = jnp.cumsum(neg_h[::-1])[::-1]
+    prec = tp / jnp.maximum(tp + fp, 1e-20)
+    total_pos = jnp.sum(pos_h)
+    ap = jnp.sum(prec * pos_h) / jnp.maximum(total_pos, 1e-30)
+    return jnp.where(total_pos == 0, 1.0, ap)
+
+
+def device_auc_mu(prob, label, w, class_weights: np.ndarray,
+                  num_bins: int = 4096):
+    """auc_mu over sharded rows (multi-process form of AucMuMetric):
+    each class pair's rows are projected on v = W[i]-W[j] (row-local),
+    then a binned two-class AUC runs per pair — every term is a plain
+    sum, so GSPMD reduces the sharded rows.  Tie credit is quantized to
+    the bin resolution like device_binned_auc."""
+    import jax.numpy as jnp
+    K = prob.shape[0]
+    Wm = np.asarray(class_weights, np.float32)
+    total = 0.0
+    for i in range(K):
+        for j in range(i + 1, K):
+            v = jnp.asarray(Wm[i] - Wm[j])
+            t1 = float(Wm[i, i] - Wm[j, i] - (Wm[i, j] - Wm[j, j]))
+            dist = t1 * jnp.einsum("k,kn->n", v, prob)
+            in_pair = (label == i) | (label == j)
+            wp = jnp.where(in_pair, w, 0.0)
+            total = total + device_binned_auc(dist, (label == i), wp,
+                                              num_bins=num_bins)
+    return 2.0 * total / (K * (K - 1))
+
+
+def map_device_plan(metric: "MapMetric", n_pad: int, shared_buckets=None):
+    """Device evaluation plan for MAP@k over sharded scores (the
+    multi-process form of MapMetric.eval; ref map_metric.hpp:17):
+    per-query sorted-precision sums from bucketed sort programs, with
+    per-query positive counts and denominators precomputed host-side
+    (labels are static).  Returns (bucket_args, eval_fn)."""
+    import jax.numpy as jnp
+    lab_all = metric.label
+    ks = list(metric.eval_at)
+    buckets = []
+    nq = 0
+    for bi, b in enumerate(bucket_queries(metric.query_boundaries, n_pad)):
+        Qb, m = len(b["qs"]), b["m"]
+        rel = np.zeros((Qb, m), np.float32)
+        denom = np.zeros((Qb, len(ks)), np.float32)
+        for r, q in enumerate(b["qs"]):
+            a, e = (int(metric.query_boundaries[q]),
+                    int(metric.query_boundaries[q + 1]))
+            rq = (lab_all[a:e] > 0)
+            rel[r, :e - a] = rq
+            npos = int(rq.sum())
+            for ki, k in enumerate(ks):
+                denom[r, ki] = min(npos, min(k, e - a))
+        sh = (shared_buckets[bi] if shared_buckets is not None
+              and bi < len(shared_buckets)
+              and shared_buckets[bi]["idx"].shape == b["idx"].shape
+              else None)
+        buckets.append({"idx": sh["idx"] if sh else jnp.asarray(b["idx"]),
+                        "val": sh["val"] if sh else jnp.asarray(b["val"]),
+                        "rel": jnp.asarray(rel),
+                        "denom": jnp.asarray(denom)})
+        nq += Qb
+
+    def eval_fn(sc, bucket_args):
+        sums = jnp.zeros(len(ks), jnp.float32)
+        for bk in bucket_args:
+            m = bk["idx"].shape[1]
+            scb = jnp.take(sc, bk["idx"])
+            key = jnp.where(bk["val"], scb, -jnp.inf)
+            order = jnp.argsort(-key, axis=1, stable=True)
+            rel_sorted = jnp.take_along_axis(bk["rel"], order, 1)
+            cum = jnp.cumsum(rel_sorted, axis=1)
+            pos_idx = jnp.arange(m, dtype=jnp.float32) + 1.0
+            prec_at_hit = jnp.where(rel_sorted > 0,
+                                    cum / pos_idx[None, :], 0.0)
+            terms = []
+            for ki, k in enumerate(ks):
+                kk = min(k, m)
+                s = jnp.sum(prec_at_hit[:, :kk], axis=1)
+                d = bk["denom"][:, ki]
+                terms.append(jnp.sum(jnp.where(d > 0,
+                                               s / jnp.maximum(d, 1.0),
+                                               1.0)))
+            sums = sums + jnp.stack(terms)
+        return sums / nq
+
+    return buckets, eval_fn
 
 
 def bucket_queries(query_boundaries, n_pad: int):

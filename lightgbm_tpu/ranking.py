@@ -369,13 +369,107 @@ class LambdarankNDCG(RankingObjective):
 
 
 class RankXENDCG(RankingObjective):
-    """ref: rank_objective.hpp:362 RankXENDCG."""
+    """ref: rank_objective.hpp:362 RankXENDCG.
+
+    Gradients run ON DEVICE by default (make_device_grad_fn), like
+    lambdarank: queries are bucketed by padded pow2 length and each
+    bucket computes its masked-softmax + three order-correction passes
+    as one [Qb, m] tensor program — the TPU analogue of the per-query
+    CUDA kernels (ref: cuda_rank_objective.cu:385,502,618
+    GetGradientsKernel_RankXENDCG variants).  Per-query Gumbel draws use
+    `jax.random.fold_in(iteration_key, query_id)` instead of the host's
+    per-query numpy RandomState streams — same independence structure,
+    different streams (the documented RNG deviation this file already
+    makes for extra-trees seeds)."""
     name = "rank_xendcg"
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         self.rands = [np.random.RandomState(self.seed + q)
                       for q in range(self.num_queries)]
+
+    # ------------------------------------------------------------------
+    def make_device_grad_fn(self, n_pad: int):
+        """Bucketed device gradient program; None when position bias is
+        active (the generic host Newton loop handles that rare mode)."""
+        if self.positions is not None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        from .metric import bucket_queries
+        qb = self.query_boundaries
+        buckets = []
+        for b in bucket_queries(qb, n_pad):
+            Qb, m = len(b["qs"]), b["m"]
+            lab = np.zeros((Qb, m), np.int32)
+            for r, q in enumerate(b["qs"]):
+                a, e = int(qb[q]), int(qb[q + 1])
+                lab[r, :e - a] = self.label[a:e].astype(np.int32)
+            buckets.append(dict(
+                idx=jnp.asarray(b["idx"]), lab=jnp.asarray(lab),
+                val=jnp.asarray(b["val"]),
+                qid=jnp.asarray(np.asarray(b["qs"], np.int32))))
+        f32 = jnp.float32
+        seed = self.seed
+
+        def bucket_grads(key_it, sc_b, lab_b, val_b, qid_b):
+            """Vectorized mirror of _one_query over a [Qb, m] block."""
+            m = sc_b.shape[1]
+            scm = jnp.where(val_b, sc_b, -jnp.inf)
+            mx = jnp.max(scm, axis=1, keepdims=True)
+            e = jnp.where(val_b, jnp.exp(sc_b - mx), 0.0)
+            rho = e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True),
+                                  K_EPSILON)
+            keys = jax.vmap(lambda q: jax.random.fold_in(key_it, q))(qid_b)
+            u = jax.vmap(lambda k: jax.random.uniform(k, (m,)))(keys)
+            params = jnp.where(val_b,
+                               jnp.exp2(lab_b.astype(f32)) - u, 0.0)
+            inv_den = 1.0 / jnp.maximum(
+                jnp.sum(params, axis=1, keepdims=True), K_EPSILON)
+            # guard 1/(1-rho): float32 rho can saturate to 1.0 on widely
+            # separated scores (the float64 host loop cannot)
+            inv_1m = 1.0 / jnp.maximum(1.0 - rho, K_EPSILON)
+            l1 = jnp.where(val_b, -params * inv_den + rho, 0.0)
+            lambdas = l1
+            p1 = l1 * inv_1m
+            sum_l1 = jnp.sum(jnp.where(val_b, p1, 0.0), 1, keepdims=True)
+            l2 = rho * (sum_l1 - p1)
+            lambdas = lambdas + jnp.where(val_b, l2, 0.0)
+            p2 = l2 * inv_1m
+            sum_l2 = jnp.sum(jnp.where(val_b, p2, 0.0), 1, keepdims=True)
+            lambdas = lambdas + jnp.where(val_b, rho * (sum_l2 - p2), 0.0)
+            hess = jnp.where(val_b, rho * (1.0 - rho), 0.0)
+            keep = (jnp.sum(val_b, axis=1) > 1)[:, None]   # cnt<=1: zeros
+            return (jnp.where(keep & val_b, lambdas, 0.0),
+                    jnp.where(keep & val_b, hess, 0.0))
+
+        def grad_fn(scores, weight, bucket_args, it):
+            sc = scores[0].astype(f32)
+            key_it = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+            g = jnp.zeros(n_pad, f32)
+            h = jnp.zeros(n_pad, f32)
+            for bk in bucket_args:
+                sc_b = jnp.take(sc, bk["idx"])
+                lam, hes = bucket_grads(key_it, sc_b, bk["lab"],
+                                        bk["val"], bk["qid"])
+                g = g.at[bk["idx"].reshape(-1)].add(lam.reshape(-1))
+                h = h.at[bk["idx"].reshape(-1)].add(hes.reshape(-1))
+            if weight is not None:
+                g = g * weight
+                h = h * weight
+            return g[None, :], h[None, :]
+
+        jitted = jax.jit(grad_fn)
+        self._xe_iter = 0
+
+        def call(scores, weight):
+            g, h = jitted(scores, weight, buckets,
+                          jnp.asarray(self._xe_iter, jnp.int32))
+            self._xe_iter += 1
+            return g, h
+
+        return call
 
     def _one_query(self, qid, label, score):
         cnt = len(label)

@@ -99,3 +99,54 @@ def test_serialization_roundtrip():
     m2 = BinMapper.from_dict(m.to_dict())
     vals = rng.normal(size=100)
     assert (m.values_to_bins(vals) == m2.values_to_bins(vals)).all()
+
+
+def test_device_bucketize_matches_host_searchsorted():
+    """The device second pass (io/device_bin.py) must reproduce the host
+    values_to_bins codes bit-for-bit on float32 data — including NaN
+    handling for both missing conventions and values landing exactly on
+    float64 bin bounds (the floor32 rounding argument)."""
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.io.device_bin import bin_matrix_device
+
+    rng = np.random.RandomState(5)
+    n, F = 20000, 6
+    X = np.empty((n, F), np.float32)
+    X[:, 0] = rng.randn(n)
+    X[:, 1] = np.abs(rng.randn(n)) ** 1.5
+    X[:, 2] = rng.rand(n)
+    X[:, 3] = rng.randint(0, 10, n)          # coarse ints -> heavy ties
+    X[:, 4] = rng.randn(n)
+    X[:, 4][rng.rand(n) < 0.1] = np.nan      # NaN missing
+    X[:, 5] = rng.randn(n) * 1e-3
+    X[:, 5][rng.rand(n) < 0.3] = 0.0         # zero-heavy
+
+    ds = Dataset.construct_from_arrays(X.astype(np.float64),
+                                       label=np.zeros(n))
+    # place many values EXACTLY on the float64 bounds of feature 0
+    m0 = ds.bin_mappers[ds.used_features[0]]
+    finite = m0.bin_upper_bound[np.isfinite(m0.bin_upper_bound)]
+    if len(finite):
+        X[:len(finite) * 3, 0] = np.tile(
+            finite.astype(np.float32), 3)[:len(finite) * 3]
+
+    host = np.stack([ds.bin_mappers[f].values_to_bins(
+        X[:, f].astype(np.float64)) for f in ds.used_features])
+    dev = bin_matrix_device(X, ds.bin_mappers, ds.used_features,
+                            chunk=4096)
+    np.testing.assert_array_equal(host.astype(np.int32),
+                                  dev.astype(np.int32))
+
+
+def test_device_binnable_gate():
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.io.device_bin import device_binnable
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 3)
+    ds = Dataset.construct_from_arrays(X, label=np.zeros(2000))
+    # float64 data must never take the device path (needs full mantissa)
+    assert not device_binnable(ds.bin_mappers, ds.used_features,
+                               np.float64, 10_000_000)
+    # small n stays on host regardless
+    assert not device_binnable(ds.bin_mappers, ds.used_features,
+                               np.float32, 2000)

@@ -302,7 +302,7 @@ X = rng.rand(n, 5)
 y = (X[:, 0] * 3 + X[:, 1]).astype(np.int64) % 3
 b = lgb.train({"objective": "multiclass", "num_class": 3, "num_leaves": 7,
                "verbosity": -1, "tree_learner": "data",
-               "metric": "multi_logloss,multi_error",
+               "metric": "multi_logloss,multi_error,auc_mu",
                "tpu_growth_strategy": "leafwise", "min_data_in_leaf": 5},
               lgb.Dataset(X, label=y.astype(np.float64)),
               num_boost_round=3)
@@ -324,7 +324,7 @@ def test_multiprocess_multiclass_train_eval(tmp_path):
     r0 = json.loads(outs[0].read_text())
     r1 = json.loads(outs[1].read_text())
     assert r0 == r1, (r0, r1)
-    assert set(r0) == {"multi_logloss", "multi_error"}
+    assert set(r0) == {"multi_logloss", "multi_error", "auc_mu"}
 
     import numpy as np
     import lightgbm_tpu as lgb
@@ -334,7 +334,7 @@ def test_multiprocess_multiclass_train_eval(tmp_path):
     y = (X[:, 0] * 3 + X[:, 1]).astype(np.int64) % 3
     b = lgb.train({"objective": "multiclass", "num_class": 3,
                    "num_leaves": 7, "verbosity": -1,
-                   "metric": "multi_logloss,multi_error",
+                   "metric": "multi_logloss,multi_error,auc_mu",
                    "tpu_growth_strategy": "leafwise",
                    "min_data_in_leaf": 5},
                   lgb.Dataset(X, label=y.astype(np.float64)),
@@ -343,6 +343,8 @@ def test_multiprocess_multiclass_train_eval(tmp_path):
     assert abs(ref["multi_logloss"] - r0["multi_logloss"]) < 2e-4
     # models differ in leaf-value ulps; allow a few row flips
     assert abs(ref["multi_error"] - r0["multi_error"]) < 5 / 3072
+    # auc_mu: binned pairwise AUCs (resolution 1/4096) vs exact host
+    assert abs(ref["auc_mu"] - r0["auc_mu"]) < 3e-3
 
 
 _RANK_EVAL_WORKER = r"""
@@ -364,7 +366,7 @@ n = int(sizes.sum())
 X = rng.rand(n, 5)
 y = rng.randint(0, 4, n).astype(np.float64)
 b = lgb.train({"objective": "lambdarank", "num_leaves": 7, "verbosity": -1,
-               "tree_learner": "data", "metric": "ndcg",
+               "tree_learner": "data", "metric": "ndcg,map",
                "ndcg_eval_at": [1, 5], "min_data_in_leaf": 2,
                "tpu_growth_strategy": "leafwise"},
               lgb.Dataset(X, label=y, group=sizes), num_boost_round=3)
@@ -387,7 +389,7 @@ def test_multiprocess_ndcg_train_eval(tmp_path):
     r0 = json.loads(outs[0].read_text())
     r1 = json.loads(outs[1].read_text())
     assert r0 == r1, (r0, r1)
-    assert set(r0) == {"ndcg@1", "ndcg@5"}
+    assert set(r0) == {"ndcg@1", "ndcg@5", "map@1", "map@5"}
 
     import numpy as np
     import lightgbm_tpu as lgb
@@ -397,7 +399,7 @@ def test_multiprocess_ndcg_train_eval(tmp_path):
     X = rng.rand(n, 5)
     y = rng.randint(0, 4, n).astype(np.float64)
     b = lgb.train({"objective": "lambdarank", "num_leaves": 7,
-                   "verbosity": -1, "metric": "ndcg",
+                   "verbosity": -1, "metric": "ndcg,map",
                    "ndcg_eval_at": [1, 5], "min_data_in_leaf": 2,
                    "tpu_growth_strategy": "leafwise"},
                   lgb.Dataset(X, label=y, group=sizes), num_boost_round=3)
@@ -406,7 +408,7 @@ def test_multiprocess_ndcg_train_eval(tmp_path):
     # values differ in ulps, so budget a couple of per-query rank flips
     # (1/64 each at ndcg@1); rank-identity across workers is asserted
     # exactly above
-    for k in ("ndcg@1", "ndcg@5"):
+    for k in ("ndcg@1", "ndcg@5", "map@1", "map@5"):
         assert abs(ref[k] - r0[k]) < 2.5 / 64, (k, ref[k], r0[k])
 
 

@@ -63,10 +63,31 @@ def main():
     _ = np.asarray(g) + np.asarray(h)  # completion barrier
     dev_s = (time.time() - t0) / REPS
 
+    # rank_xendcg: same shapes, same harness (device program added in
+    # round 5; ref cuda_rank_objective.cu:385-624)
+    from lightgbm_tpu.ranking import RankXENDCG
+    xobj = RankXENDCG(Config({"objective": "rank_xendcg"}))
+    xobj.init(md, n)
+    t0 = time.time()
+    for _ in range(3):
+        xobj.get_gradients_host(score)
+    xe_host_s = (time.time() - t0) / 3
+    xfn = xobj.make_device_grad_fn(n_pad)
+    g, h = xfn(sc, None)
+    _ = np.asarray(g)
+    t0 = time.time()
+    for _ in range(REPS):
+        g, h = xfn(sc, None)
+    _ = np.asarray(g) + np.asarray(h)
+    xe_dev_s = (time.time() - t0) / REPS
+
     out = {"docs": n, "queries": len(lens),
            "host_grad_s": round(host_s, 4),
            "device_grad_s": round(dev_s, 4),
-           "speedup": round(host_s / dev_s, 2)}
+           "speedup": round(host_s / dev_s, 2),
+           "xendcg_host_grad_s": round(xe_host_s, 4),
+           "xendcg_device_grad_s": round(xe_dev_s, 4),
+           "xendcg_speedup": round(xe_host_s / xe_dev_s, 2)}
     print(json.dumps(out))
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "bench_ranking.json")
