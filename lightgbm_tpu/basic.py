@@ -868,8 +868,27 @@ class Booster:
     # ------------------------------------------------------------------
     def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_contrib: bool = False, single_row_fast: bool = False,
+                **kwargs) -> np.ndarray:
         from .io.sparse import is_scipy_sparse
+        if (single_row_fast and not pred_leaf and not pred_contrib
+                and not is_scipy_sparse(data)):
+            row = np.asarray(data, np.float64)
+            if row.ndim == 1:
+                row = row[None, :]
+            # the model may reference any original feature index: a
+            # narrower row would read past the C buffer — fall through
+            # to the batch path, which validates/raises
+            if row.shape[0] == 1 and row.shape[1] >= self.num_feature():
+                sp = self._single_row_fast_for(
+                    row.shape[1], start_iteration,
+                    -1 if num_iteration is None else num_iteration,
+                    raw_score)
+                if sp is not None:
+                    out = sp.predict(row[0])
+                    # match the batch path's shapes: [1] binary/reg,
+                    # [1, K] multiclass
+                    return out[None, :] if len(out) > 1 else out[:1]
         if is_scipy_sparse(data) and data.shape[0] == 0 and pred_contrib:
             # keep the sparse-in -> sparse-out contract on the empty edge
             from scipy import sparse as sps
@@ -915,6 +934,27 @@ class Booster:
                                   start_iteration=start_iteration,
                                   num_iteration=num_iteration,
                                   pred_leaf=pred_leaf, **pred_kwargs)
+
+    def _single_row_fast_for(self, num_features, start_iteration,
+                             num_iteration, raw_score):
+        """Cached per-(slice, raw) fast predictors; invalidated by model
+        growth (ref: the FastConfig handle of c_api.h:1350).  A dict so
+        serving loops alternating raw/converted or slices keep every
+        variant warm."""
+        key = (num_features, start_iteration, num_iteration, raw_score,
+               len(self._gbdt.models_),
+               getattr(self._gbdt, "_model_mutations", 0))
+        cache = getattr(self, "_srf_cache", None)
+        if cache is None or cache.get("model_key") != key[4:]:
+            cache = {"model_key": key[4:]}     # model changed: drop all
+            self._srf_cache = cache
+        if key not in cache:
+            if num_iteration == -1 and self.best_iteration > 0:
+                num_iteration = self.best_iteration
+            cache[key] = self._gbdt.make_single_row_fast(
+                num_features, start_iteration=start_iteration,
+                num_iteration=num_iteration, raw_score=raw_score)
+        return cache[key]
 
     # ------------------------------------------------------------------
     def refit(self, data, label, weight=None, **kwargs) -> "Booster":

@@ -462,6 +462,8 @@ class GBDT:
             wave_tail_halving=config.wave_tail_halving,
             wave_prune=config.wave_prune,
             wave_prune_overshoot=config.wave_prune_overshoot,
+            wave_spike_reserve=config.wave_spike_reserve,
+            wave_spike_k=config.wave_spike_k,
             # int8 MXU histogram path for quantized training (grid must
             # fit int8; hessian ints reach num_grad_quant_bins).  The
             # int32 accumulator must hold n * max_int for a root-level
@@ -577,17 +579,21 @@ class GBDT:
                     sets.append(idxs)
             self.grow_params = self.grow_params._replace(
                 interaction_sets=tuple(sets))
-        if (self.grow_params.forced_splits
-                or self.grow_params.voting is not None
+        if (self.grow_params.voting is not None
                 or self.grow_params.monotone_intermediate
                 or self.grow_params.split.has_cegb_lazy):
-            # interaction constraints run on the wave engine (per-leaf
-            # branch masks compose with waves AND with prune: allowed
-            # features depend only on the leaf's path)
+            # interaction constraints and forced splits run on the wave
+            # engine (branch masks compose with waves; forced splits
+            # apply as a one-split-per-wave prologue, wave.py).  Voting
+            # elects per-leaf feature sets (children not derivable by
+            # subtraction), and intermediate monotone / lazy CEGB
+            # recompute global state after EVERY split — inherently
+            # sequential, so they keep the leaf-wise engine (measured
+            # 0.958 s/iter at bench scale vs the same-host oracle's
+            # 9.8 — see PERF_NOTES).
             if strategy == "wave":
-                log.warning("forced splits / voting / intermediate "
-                            "monotone / lazy CEGB use the leaf-wise "
-                            "engine")
+                log.warning("voting / intermediate monotone / lazy CEGB "
+                            "use the leaf-wise engine")
             strategy = "leafwise"
         if strategy == "auto":
             strategy = ("wave" if jax.default_backend() == "tpu"
@@ -1559,6 +1565,31 @@ class GBDT:
             self._packed_pred = cached
         packed = cached[1]
         return packed if packed.ok else None
+
+    def make_single_row_fast(self, num_features: int,
+                             start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             raw_score: bool = False):
+        """Cached single-row fast predictor (ref: c_api.h:1350
+        LGBM_BoosterPredictForMatSingleRowFastInit): parse/pack once,
+        reuse buffers per call.  None when the native predictor is
+        unavailable (linear trees / no compiler)."""
+        from ..native import SingleRowFastPredictor
+        self._sync_model()
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models_) // K
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        packed = self._packed_for(start_iteration, end, K)
+        if packed is None:
+            return None
+        conv = None
+        if not raw_score and self.objective is not None:
+            conv = getattr(self.objective, "convert_output_host", None)
+        sp = SingleRowFastPredictor(packed, num_features, K,
+                                    self.average_output_, convert=conv)
+        return sp if sp.ok else None
 
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, pred_early_stop: bool = False,

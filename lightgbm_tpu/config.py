@@ -111,6 +111,8 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # whenever its splits lie within the overgrown region
     ("wave_prune", "bool", True, ()),
     ("wave_prune_overshoot", "float", 1.5, ()),
+    ("wave_spike_reserve", "int", 0, ()),
+    ("wave_spike_k", "int", 8, ()),
     ("num_threads", "int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
     ("device_type", "str", "tpu", ("device",)),
     ("seed", "int", 0, ("random_seed", "random_state")),

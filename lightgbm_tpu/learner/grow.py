@@ -127,6 +127,11 @@ class GrowParams(NamedTuple):
     # gains/constraints depend on realized split order).
     wave_prune: bool = False
     wave_prune_overshoot: float = 1.5
+    # prune mode: leaves of the overgrow budget reserved for narrow
+    # best-gain-only "spike" waves after the broad ladder (8 per wave;
+    # deep probes into the top-gain frontier, see wave.py).  0 disables.
+    wave_spike_reserve: int = 0
+    wave_spike_k: int = 8        # splits per spike wave
     # monotone_constraints_method=advanced (ref:
     # monotone_constraints.hpp:858 AdvancedLeafConstraints): per-(leaf,
     # feature, threshold) constraint surfaces derived from the leaf
@@ -141,6 +146,56 @@ class GrowParams(NamedTuple):
     # histograms (ref: data_parallel_tree_learner.cpp:282-295).  None in
     # single-device / GSPMD-annotated runs.
     data_axis: object = None
+
+
+def gather_forced_split(hist, ffeat, fthr, sum_g, sum_h_raw, nleaf,
+                        meta: "FeatureMeta", B: int, sp) -> "SplitResult":
+    """Scalar SplitResult for a FORCED (feature, threshold) split of one
+    leaf, gathered from its feature-space histogram [F, B, 2] (ref:
+    feature_histogram GatherInfoForThreshold; serial_tree_learner.cpp:614
+    ForceSplits).  Missing values join the right side (default_left=False
+    matches the partition rule both engines apply).  Shared by the
+    leaf-wise prologue (forced_pending) and the wave engine's forced
+    waves so the gather semantics cannot diverge."""
+    from ..ops.split import leaf_gain, leaf_output
+    f32 = jnp.float32
+    sum_h = sum_h_raw + 2e-15
+    cnt_factor = nleaf / sum_h
+    bins = jnp.arange(B, dtype=jnp.int32)
+    nb = meta.num_bin[ffeat]
+    is_na = ((meta.missing_type[ffeat] == MISSING_NAN) & (bins == nb - 1))
+    # MISSING_ZERO rows (the default bin) route right, matching
+    # go_left_of's default_left=False partition of this split
+    is_zero = ((meta.missing_type[ffeat] == MISSING_ZERO)
+               & (bins == meta.default_bin[ffeat]))
+    take = (bins <= fthr) & (bins < nb) & ~is_na & ~is_zero
+    hf = hist[ffeat]
+    lg = jnp.sum(jnp.where(take, hf[:, 0], 0.0))
+    lh_raw = jnp.sum(jnp.where(take, hf[:, 1], 0.0))
+    lh = lh_raw + 1e-15
+    lc = jnp.round(lh_raw * cnt_factor).astype(jnp.int32)
+    rg = sum_g - lg
+    rh = sum_h - lh
+    rc = jnp.round(nleaf).astype(jnp.int32) - lc
+    po = jnp.asarray(0.0, f32)
+    gain = (leaf_gain(lg, lh, lc.astype(f32), po, sp)
+            + leaf_gain(rg, rh, rc.astype(f32), po, sp))
+    valid = (lc > 0) & (rc > 0)
+    from ..ops.split import SplitResult
+    return SplitResult(
+        gain=jnp.where(valid, gain, K_MIN_SCORE),
+        feature=jnp.asarray(ffeat, jnp.int32),
+        threshold=jnp.asarray(fthr, jnp.int32),
+        default_left=jnp.asarray(False),
+        left_sum_gradient=lg, left_sum_hessian=lh - 1e-15,
+        left_count=lc,
+        left_output=leaf_output(lg, lh, lc.astype(f32), po, sp),
+        right_sum_gradient=rg, right_sum_hessian=rh - 1e-15,
+        right_count=rc,
+        right_output=leaf_output(rg, rh, rc.astype(f32), po, sp),
+        is_cat=jnp.asarray(False),
+        cat_bitset=jnp.zeros(cat_bitset_words(B), jnp.int32))
+
 
 
 def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
@@ -1054,50 +1109,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     def forced_pending(st: _State, leaf, feat, thr):
         """Pending entry for a forced (feature, threshold) split of
-        `leaf`, gathered from its histogram (ref: feature_histogram
-        GatherInfoForThreshold).  Missing values join the right side."""
-        sum_g = st.leaf_sum_g[leaf]
-        sum_h = st.leaf_sum_h[leaf] + 2 * 1e-15
+        `leaf` (shared gather: gather_forced_split)."""
         hist = bundle_hist_to_features(
-            st.hist_stack[leaf], sum_g, st.leaf_sum_h[leaf], meta, B,
-            hist_B, params.has_bundles)
-        nleaf = st.tree.leaf_count[leaf].astype(f32)
-        cnt_factor = nleaf / sum_h
-        bins = jnp.arange(B, dtype=jnp.int32)
-        nb = meta.num_bin[feat]
-        is_na = ((meta.missing_type[feat] == MISSING_NAN)
-                 & (bins == nb - 1))
-        # MISSING_ZERO rows (the default bin) route right, matching
-        # go_left_of's default_left=False partition of this split
-        is_zero = ((meta.missing_type[feat] == MISSING_ZERO)
-                   & (bins == meta.default_bin[feat]))
-        take = (bins <= thr) & (bins < nb) & ~is_na & ~is_zero
-        hf = hist[feat]
-        lg = jnp.sum(jnp.where(take, hf[:, 0], 0.0))
-        lh_raw = jnp.sum(jnp.where(take, hf[:, 1], 0.0))
-        lh = lh_raw + 1e-15
-        lc = jnp.round(lh_raw * cnt_factor).astype(jnp.int32)
-        rg = sum_g - lg
-        rh = sum_h - lh
-        rc = st.tree.leaf_count[leaf].astype(jnp.int32) - lc
-        po = st.pending.left_output[leaf] * 0.0
-        from ..ops.split import leaf_gain, leaf_output
-        gain = (leaf_gain(lg, lh, lc.astype(f32), po, sp)
-                + leaf_gain(rg, rh, rc.astype(f32), po, sp))
-        valid = (lc > 0) & (rc > 0)
-        res = SplitResult(
-            gain=jnp.where(valid, gain, K_MIN_SCORE),
-            feature=jnp.asarray(feat, jnp.int32),
-            threshold=jnp.asarray(thr, jnp.int32),
-            default_left=jnp.asarray(False),
-            left_sum_gradient=lg, left_sum_hessian=lh - 1e-15,
-            left_count=lc,
-            left_output=leaf_output(lg, lh, lc.astype(f32), po, sp),
-            right_sum_gradient=rg, right_sum_hessian=rh - 1e-15,
-            right_count=rc,
-            right_output=leaf_output(rg, rh, rc.astype(f32), po, sp),
-            is_cat=jnp.asarray(False),
-            cat_bitset=jnp.zeros(cat_bitset_words(B), jnp.int32))
+            st.hist_stack[leaf], st.leaf_sum_g[leaf], st.leaf_sum_h[leaf],
+            meta, B, hist_B, params.has_bundles)
+        res = gather_forced_split(
+            hist, feat, thr, st.leaf_sum_g[leaf], st.leaf_sum_h[leaf],
+            st.tree.leaf_count[leaf].astype(f32), meta, B, sp)
         return st._replace(pending=_pending_set(st.pending, leaf, res))
 
     forcing_ok = jnp.asarray(True)

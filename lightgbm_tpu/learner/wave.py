@@ -49,9 +49,10 @@ import jax.numpy as jnp
 
 from ..ops.histogram import (build_histogram_wave, build_histogram_wave_hl,
                              hl_split_of, wave_hl_profitable, wave_slot_pad)
-from ..ops.split import K_MIN_SCORE, cat_bitset_words, find_best_split
+from ..ops.split import (K_MIN_SCORE, SplitResult, cat_bitset_words,
+                         find_best_split)
 from .grow import (FeatureMeta, GrowParams, TreeArrays,
-                   bundle_hist_to_features)
+                   bundle_hist_to_features, gather_forced_split)
 
 
 def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
@@ -266,6 +267,19 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                 0 if (use_bynode or use_interaction)
                                 else None))
 
+    # incremental gain scan: a leaf's best split depends only on its own
+    # histogram/sums, which change ONLY when the leaf is created — so in
+    # the plain mode the per-wave scan touches just the <= 2*Kb leaves
+    # the previous wave created instead of all NLp (the reference
+    # likewise scans only the two fresh leaves per split,
+    # serial_tree_learner.cpp:340 FindBestSplits).  Modes whose scan
+    # inputs change globally per wave (fresh extra-trees/bynode draws,
+    # branch-dependent interaction masks, monotone constraint updates,
+    # CEGB's used-feature set) keep the full rescan.
+    incremental_scan = not (sp.extra_trees or use_bynode
+                            or use_interaction or sp.has_monotone
+                            or sp.has_cegb)
+
     sum_g0 = _psum(jnp.sum(grad))
     sum_h0 = _psum(jnp.sum(hess))
     cnt0 = _psum(jnp.sum(row_mask)).astype(i32)
@@ -273,10 +287,28 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # overgrow-and-prune quality mode (see GrowParams.wave_prune): the
     # ladder grows to Lg > L leaves, then the leaf-wise pop order is
     # simulated over the overgrown gains and the tree pruned back to L
+    # prune composes with tail_halving: halving only changes WHICH nodes
+    # the overgrown ladder explores (gain-adaptive tail allocation), the
+    # replay then picks the leaf-wise order over whatever was grown.
+    # Forced splits disable prune: the replay ranks by gain and could
+    # discard a forced node (the reference keeps forced splits
+    # unconditionally, serial_tree_learner.cpp:614).
     prune = (params.wave_prune and L > 2 and not sp.has_monotone
-             and not sp.has_cegb and not params.wave_tail_halving)
+             and not sp.has_cegb and not params.forced_splits)
     Lg = (min(max(L, int(math.ceil(L * params.wave_prune_overshoot))),
               4 * L) if prune else L)
+    # spike waves (prune mode): reserve part of the overgrow budget for
+    # a few best-gain-ONLY waves after the broad ladder — narrow deep
+    # probes into the top-gain frontier, which is where the leaf-wise
+    # order spends the splits the level-uniform ladder misses (the
+    # "exploration adaptivity" residual of PERF_NOTES).  Each spike wave
+    # computes <= 8 slots, so it rides the cheap decomposed hi/lo kernel.
+    spike_k = int(getattr(params, "wave_spike_k", 8) or 8)
+    spike_waves = (int(params.wave_spike_reserve) // spike_k
+                   if prune and L >= 8 * spike_k else 0)
+    reserve = min(spike_waves * spike_k, max(Lg - L, 0))
+    spike_waves = reserve // spike_k
+    Lg_main = Lg - spike_waves * spike_k
 
     ni = max(Lg - 1, 1)
     W = cat_bitset_words(B)
@@ -387,24 +419,40 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cache_c = cache_c * keep + jnp.sum(W * child_c[None, :], axis=1)
         return cache_h, cache_c
 
-    def wave_body(state, NLp, Kb, first=False, Ks=None):
+    def _forced_entry(fleaf, ffeat, fthr, cache_h, cache_c, leaf_sum_g,
+                      leaf_sum_h):
+        """SplitResult for a forced split of `fleaf` from its cached
+        histogram (shared gather: grow.gather_forced_split)."""
+        hist = bundle_hist_to_features(
+            cache_h[fleaf].reshape(Fh, hist_B, 2), leaf_sum_g[fleaf],
+            leaf_sum_h[fleaf], meta, B, hist_B, params.has_bundles)
+        res = gather_forced_split(hist, ffeat, fthr, leaf_sum_g[fleaf],
+                                  leaf_sum_h[fleaf], cache_c[fleaf],
+                                  meta, B, sp)
+        return res, res.gain > K_MIN_SCORE
+
+    def wave_body(state, NLp, Kb, first=False, Ks=None, lg_cap=None,
+                  budget_cap=None, forced=None):
         """One wave with a static slot bound NLp >= current num_leaves and
         a static computed-slot bound Kb >= splits of the previous wave.
         Ks is the TRUE (unpadded) computed-slot bound for the decomposed
-        small-S histogram kernel."""
+        small-S histogram kernel.  `lg_cap` bounds the leaf budget (the
+        overgrow target for this PHASE of growth; defaults to Lg) and
+        `budget_cap` additionally caps the splits of this single wave
+        (the spike waves' narrow best-gain-only deepening)."""
         (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
          leaf_cmin, leaf_cmax, used_vec, leaf_branch, cache_h, cache_c,
-         pend_sel, pend_new, pend_rank, pend_sl, _) = state
+         pend_sel, pend_new, pend_rank, pend_sl, best_state, _) = state
         NL = tree.num_leaves
 
         # 1. refresh the per-leaf cache for last wave's children (smaller
-        #    child computed, larger by subtraction), then scan ALL leaves
-        #    from the cache (DataPartition cnt_leaf_data exactness rides
-        #    the count cache)
+        #    child computed, larger by subtraction), then scan the leaves
+        #    whose histograms changed (all of them on the first wave /
+        #    non-incremental modes; DataPartition cnt_leaf_data exactness
+        #    rides the count cache)
         cache_h, cache_c = wave_hists(kslot, cache_h, cache_c, pend_sel,
                                       pend_new, pend_rank, pend_sl, Kb,
                                       first, Ks)
-        hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
         counts = jnp.round(cache_c[:NLp]).astype(i32)
         active = jnp.arange(NLp, dtype=i32) < NL
         rb = (_rand_bins(tree.num_leaves)[:NLp] if sp.extra_trees else None)
@@ -418,32 +466,78 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if use_interaction:
             allow = _allowed_of(leaf_branch[:NLp])
             bym = allow if bym is None else (bym & allow)
-        best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                       counts, leaf_out[:NLp], *mono_args, rb, rcu,
-                       used_vec, bym)
+        if not incremental_scan or first:
+            hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
+            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                           counts, leaf_out[:NLp], *mono_args, rb, rcu,
+                           used_vec, bym)
+            if incremental_scan:
+                best_state = jax.tree.map(
+                    lambda a, u: a.at[:NLp].set(u), best_state, best)
+        else:
+            # rescan ONLY the <= 2*Kb leaves the previous wave created:
+            # the split parents (now their left children, same slot) and
+            # the new right slots
+            psl = jnp.argsort(-pend_sel.astype(i32))[:Kb]
+            valid_p = jnp.take(pend_sel, psl)
+            parents = jnp.where(valid_p, psl, Lp)
+            news = jnp.where(valid_p, jnp.take(pend_new, psl), Lp)
+            changed = jnp.concatenate([parents, news])       # [2*Kb]
+            ch = jnp.clip(changed, 0, Lp - 1)
+            h_ch = jnp.take(cache_h, ch, axis=0).reshape(
+                2 * Kb, Fh, hist_B, 2)
+            best_ch = best_vm(h_ch, jnp.take(leaf_sum_g, ch),
+                              jnp.take(leaf_sum_h, ch),
+                              jnp.round(jnp.take(cache_c, ch)).astype(i32),
+                              jnp.take(leaf_out, ch), *mono_args,
+                              rb, rcu, used_vec, bym)
+            best_state = jax.tree.map(
+                lambda a, u: a.at[changed].set(u, mode="drop"),
+                best_state, best_ch)
+            best = jax.tree.map(lambda a: a[:NLp], best_state)
 
         # 2. select splitting leaves: positive gain, active, depth ok,
         #    best-gain-first within the remaining leaf budget
-        gain = jnp.where(active, best.gain, K_MIN_SCORE)
-        if params.max_depth > 0:
-            gain = jnp.where(tree.leaf_depth[:NLp] < params.max_depth,
-                             gain, K_MIN_SCORE)
-        want = gain > 0.0
-        budget = Lg - NL
-        if params.wave_tail_halving:
-            # once the leaf budget binds, spend at most half of it per
-            # wave (always best-gain-first): the tail of the tree then
-            # allocates leaves closer to the leaf-wise global-gain order
-            # at the cost of ~log2(L) extra (cheap, few-slot) waves —
-            # recovers most of the wave-vs-leafwise AUC gap measured in
-            # PERF_NOTES.md
-            budget = jnp.where(budget < NL, jnp.maximum((budget + 1) // 2,
-                                                        1), budget)
-        order = jnp.argsort(-gain)                    # best first
-        rank_of = jnp.zeros(NLp, i32).at[order].set(
-            jnp.arange(NLp, dtype=i32))
-        split_sel = want & (rank_of < budget)
-        n_split = jnp.sum(split_sel.astype(i32))
+        if forced is not None:
+            # forced wave (ref: serial_tree_learner.cpp:614 ForceSplits):
+            # exactly one predetermined (leaf, feature, threshold) split,
+            # applied regardless of gain RANK/SIGN but only with
+            # non-empty children and within depth/leaf budget
+            fleaf, ffeat, fthr = forced
+            fentry, fvalid = _forced_entry(fleaf, ffeat, fthr, cache_h,
+                                           cache_c, leaf_sum_g,
+                                           leaf_sum_h)
+            best = jax.tree.map(
+                lambda a, u: a.at[fleaf].set(u), best, fentry)
+            ok = fvalid & (fleaf < NL) & (NL < L)
+            if params.max_depth > 0:
+                ok = ok & (tree.leaf_depth[fleaf] < params.max_depth)
+            split_sel = (jnp.arange(NLp, dtype=i32) == fleaf) & ok
+            rank_of = jnp.zeros(NLp, i32)
+            n_split = jnp.sum(split_sel.astype(i32))
+        else:
+            gain = jnp.where(active, best.gain, K_MIN_SCORE)
+            if params.max_depth > 0:
+                gain = jnp.where(tree.leaf_depth[:NLp] < params.max_depth,
+                                 gain, K_MIN_SCORE)
+            want = gain > 0.0
+            budget = (Lg if lg_cap is None else lg_cap) - NL
+            if budget_cap is not None:
+                budget = jnp.minimum(budget, budget_cap)
+            if params.wave_tail_halving:
+                # once the leaf budget binds, spend at most half of it
+                # per wave (always best-gain-first): the tail of the
+                # tree then allocates leaves closer to the leaf-wise
+                # global-gain order at the cost of ~log2(L) extra
+                # (cheap, few-slot) waves — see PERF_NOTES.md
+                budget = jnp.where(budget < NL,
+                                   jnp.maximum((budget + 1) // 2, 1),
+                                   budget)
+            order = jnp.argsort(-gain)                # best first
+            rank_of = jnp.zeros(NLp, i32).at[order].set(
+                jnp.arange(NLp, dtype=i32))
+            split_sel = want & (rank_of < budget)
+            n_split = jnp.sum(split_sel.astype(i32))
 
         # node/new-leaf numbering by gain rank (leaf-wise split order)
         node_of = jnp.where(split_sel, NL - 1 + rank_of, 0)
@@ -639,29 +733,79 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         pend_new = lpz.at[:NLp].set(newleaf_of)
         pend_rank = lpz.at[:NLp].set(rank_of)
         pend_sl = jnp.zeros(Lp, bool).at[:NLp].set(small_left)
-        cont = (n_split > 0) & (tree.num_leaves < Lg)
+        cont = (n_split > 0) & (tree.num_leaves
+                                < (Lg if lg_cap is None else lg_cap))
         return (tree, leaf_id, kslot, leaf_sum_g, leaf_sum_h, leaf_out,
                 leaf_cmin, leaf_cmax, used_vec, leaf_branch, cache_h,
-                cache_c, pend_sel, pend_new, pend_rank, pend_sl, cont)
+                cache_c, pend_sel, pend_new, pend_rank, pend_sl,
+                best_state, cont)
 
     if cegb_used is None:
         cegb_used = jnp.zeros(num_features if sp.has_cegb else 1, bool)
     leaf_branch0 = jnp.zeros(
         (Lp, num_features) if use_interaction else (1, 1), bool)
+    # per-leaf cached best splits for the incremental scan (dummy scalar
+    # pytree when the full rescan runs — lax.cond branches must match)
+    if incremental_scan:
+        best0 = SplitResult(
+            gain=jnp.full(Lp, K_MIN_SCORE, f32),
+            feature=jnp.zeros(Lp, i32), threshold=jnp.zeros(Lp, i32),
+            default_left=jnp.zeros(Lp, bool),
+            left_sum_gradient=jnp.zeros(Lp, f32),
+            left_sum_hessian=jnp.zeros(Lp, f32),
+            left_count=jnp.zeros(Lp, i32), left_output=jnp.zeros(Lp, f32),
+            right_sum_gradient=jnp.zeros(Lp, f32),
+            right_sum_hessian=jnp.zeros(Lp, f32),
+            right_count=jnp.zeros(Lp, i32),
+            right_output=jnp.zeros(Lp, f32),
+            is_cat=jnp.zeros(Lp, bool),
+            cat_bitset=jnp.zeros((Lp, W), i32))
+    else:
+        best0 = jnp.zeros((), f32)
     state = (tree, jnp.zeros(n, i32), jnp.zeros(n, i32), leaf_sum_g0,
              leaf_sum_h0, leaf_out0, leaf_cmin0, leaf_cmax0, cegb_used,
              leaf_branch0, cache_h0, cache_c0, pend_sel0, pend_new0,
-             pend_rank0, pend_sl0, jnp.asarray(L > 1))
-    num_waves = max(1, math.ceil(math.log2(Lg))) if Lg > 1 else 0
+             pend_rank0, pend_sl0, best0, jnp.asarray(L > 1))
+    # forced prologue (ref: serial_tree_learner.cpp:614 ForceSplits): one
+    # forced split per wave, in the parse-time BFS numbering (one split
+    # per step keeps the leaf ids aligned).  The first skipped forced
+    # split aborts the rest (the reference's abort semantics); its slot
+    # returns to best-gain growth.
+    KF = min(len(params.forced_splits), max(L - 1, 0))
+    if KF:
+        forcing_ok = jnp.asarray(True)
+        for k in range(KF):
+            fleaf, ffeat, fthr = params.forced_splits[k]
+            nl_before = state[0].num_leaves
+            state = jax.lax.cond(
+                forcing_ok,
+                functools.partial(wave_body, NLp=wave_slot_pad(k + 2),
+                                  Kb=wave_slot_pad(1), first=(k == 0),
+                                  Ks=1, forced=(fleaf, ffeat, fthr)),
+                lambda s: s, state)
+            forcing_ok = forcing_ok & (state[0].num_leaves > nl_before)
+        # re-arm growth for the best-gain phase
+        state = state[:-1] + ((jnp.asarray(L > 1)
+                               & (state[0].num_leaves < Lg_main)),)
+
+    num_waves = max(1, math.ceil(math.log2(Lg_main))) if Lg_main > 1 else 0
     for k in range(num_waves):
-        NLp = wave_slot_pad(min(1 << k, Lg))
-        # computed slots this wave = splits of the previous wave, bounded
-        # by the previous wave's leaf count (root wave computes 1 slot)
-        Ks = min(1 << max(k - 1, 0), Lg)
+        # entering ladder wave k the tree has grown from <= KF+1 leaves
+        # (forced prologue) through k doubling waves: NL <= (KF+1)*2^k.
+        # The bounds must be MULTIPLICATIVE in KF+1 — an additive bound
+        # would undersize Ks and the hl kernel would silently zero-pad
+        # real children (its out_slots contract)
+        NLp = wave_slot_pad(min((KF + 1) << k, Lg_main))
+        # computed slots this wave = splits of the previous wave (root
+        # wave computes 1 slot; after a forced prologue the first ladder
+        # wave's pending split is the last forced wave's single one)
+        Ks = (1 if k == 0 and KF else
+              min((KF + 1) << max(k - 1, 0), Lg_main))
         Kb = wave_slot_pad(Ks)
         state = jax.lax.cond(state[-1],
                              functools.partial(wave_body, NLp=NLp, Kb=Kb,
-                                               first=(k == 0), Ks=Ks),
+                                               first=(k == 0 and not KF),
+                                               Ks=Ks, lg_cap=Lg_main),
                              lambda s: s, state)
     if num_waves > 0:
         # growth slower than doubling (chain-shaped gain landscapes) needs
@@ -670,8 +814,22 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # Splits per wave <= min(NL, Lg - NL) <= Lg // 2.
         state = jax.lax.while_loop(
             lambda s: s[-1],
+            functools.partial(wave_body, NLp=wave_slot_pad(Lg_main),
+                              Kb=wave_slot_pad(max(Lg_main // 2, 1)),
+                              lg_cap=Lg_main), state)
+    for s_i in range(spike_waves):
+        # narrow deepening: the previous wave may have split up to
+        # spike_k leaves (or Lg_main//2 for the first spike), so the
+        # computed-slot bound is that previous wave's split cap
+        KsS = min(spike_k if s_i > 0 else max(Lg_main // 2, 1), Lg)
+        state = state[:-1] + (jnp.asarray(True),)   # re-arm cont
+        state = jax.lax.cond(
+            state[0].num_leaves < Lg,
             functools.partial(wave_body, NLp=wave_slot_pad(Lg),
-                              Kb=wave_slot_pad(max(Lg // 2, 1))), state)
+                              Kb=wave_slot_pad(KsS),
+                              Ks=(KsS if KsS <= 16 else None),
+                              budget_cap=spike_k),
+            lambda s: s, state)
 
     def _prune_to_leafwise(tree, leaf_id):
         """Prune the overgrown (<= Lg leaves) tree back to L leaves in the

@@ -180,6 +180,63 @@ class PackedPredictor:
         return out
 
 
+class SingleRowFastPredictor:
+    """Cached single-row predict state (ref: c_api.h:1350-1379
+    LGBM_BoosterPredictForMatSingleRowFastInit / ...SingleRowFast, whose
+    FastConfig caches the parsed config and buffers, c_api.cpp:125-160).
+
+    Everything reusable is prepared ONCE: the flattened tree pack, the
+    input/output buffers, and the host-side output conversion — a
+    predict() call is one buffer write + one ctypes call, microseconds
+    per row instead of the full batch-path entry cost."""
+
+    def __init__(self, packed: "PackedPredictor", num_features: int,
+                 K: int, average: bool, convert=None):
+        self._packed = packed
+        self._K = K
+        self._convert = convert
+        self._X = np.zeros((1, num_features), np.float64)
+        self._out = np.zeros((1, K), np.float64)
+        self._lib = predictor_lib()
+        if self._lib is None or not packed.ok:
+            return
+        # marshalling 19 ndpointer args costs ~10us EACH per call: bind
+        # the raw pointers ONCE through a second (argtype-free) handle —
+        # every buffer is owned by this object / the pack, so the
+        # addresses are stable for the predictor's lifetime
+        p = packed
+        lib2 = ctypes.CDLL(self._lib._name)
+        self._fn = lib2.lgbt_predict_batch
+        self._fn.restype = None
+        vp = ctypes.c_void_p
+        cl = ctypes.c_long
+        self._cargs = (
+            vp(self._X.ctypes.data), cl(1), cl(num_features),
+            vp(p.sf.ctypes.data), vp(p.th.ctypes.data),
+            vp(p.dt.ctypes.data), vp(p.lc.ctypes.data),
+            vp(p.rc.ctypes.data), vp(p.lv.ctypes.data),
+            vp(p.cw.ctypes.data), vp(p.cb.ctypes.data),
+            vp(p.node_off.ctypes.data), vp(p.leaf_off.ctypes.data),
+            vp(p.cw_off.ctypes.data), vp(p.cb_off.ctypes.data),
+            cl(p.T), cl(K), ctypes.c_int(int(bool(average))),
+            vp(self._out.ctypes.data))
+
+    @property
+    def ok(self) -> bool:
+        return self._lib is not None and self._packed.ok
+
+    def predict(self, row) -> np.ndarray:
+        """row: [F] array-like -> [K] predictions (converted unless the
+        predictor was built raw)."""
+        self._X[0, :] = row
+        self._out[0, :] = 0.0        # the C kernel accumulates (+=)
+        self._fn(*self._cargs)
+        out = self._out[0]
+        if self._convert is not None:
+            out = self._convert(out)
+        return out.copy()
+
+
 def predict_batch_native(trees, X: np.ndarray, K: int,
                          average: bool) -> Optional[np.ndarray]:
     """One-shot native prediction (packs then predicts); callers with

@@ -84,6 +84,11 @@ class ObjectiveFunction:
         """Raw score -> prediction space (ref: ObjectiveFunction::ConvertOutput)."""
         return score
 
+    def convert_output_host(self, score: np.ndarray) -> np.ndarray:
+        """NumPy mirror of convert_output for latency-critical host
+        paths (single-row fast predict): no device round-trip."""
+        return score
+
     # -- host-side ----------------------------------------------------------
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
@@ -126,6 +131,11 @@ class RegressionL2(ObjectiveFunction):
     def convert_output(self, score):
         if self.sqrt:
             return jnp.sign(score) * score * score
+        return score
+
+    def convert_output_host(self, score):
+        if self.sqrt:
+            return np.sign(score) * score * score
         return score
 
     def boost_from_score(self, class_id: int = 0) -> float:
@@ -223,6 +233,9 @@ class RegressionPoisson(RegressionL2):
 
     def convert_output(self, score):
         return jnp.exp(score)
+
+    def convert_output_host(self, score):
+        return np.exp(score)
 
     def boost_from_score(self, class_id: int = 0) -> float:
         return float(np.log(max(super().boost_from_score(), 1e-20)))
@@ -365,6 +378,9 @@ class BinaryLogloss(ObjectiveFunction):
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
 
+    def convert_output_host(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
     def boost_from_score(self, class_id: int = 0) -> float:
         """ref: binary_objective.hpp:139-160."""
         if self.weight is not None:
@@ -416,6 +432,10 @@ class MulticlassSoftmax(ObjectiveFunction):
     def convert_output(self, score):
         return jax.nn.softmax(score, axis=0)
 
+    def convert_output_host(self, score):
+        e = np.exp(score - np.max(score, axis=0, keepdims=True))
+        return e / np.sum(e, axis=0, keepdims=True)
+
     def boost_from_score(self, class_id: int = 0) -> float:
         p = self.class_init_probs[class_id]
         return float(np.log(p)) if p > 0 else -np.inf
@@ -450,6 +470,9 @@ class MulticlassOVA(ObjectiveFunction):
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-self.binary[0].sigmoid * score))
 
+    def convert_output_host(self, score):
+        return 1.0 / (1.0 + np.exp(-self.binary[0].sigmoid * score))
+
     def boost_from_score(self, class_id: int = 0) -> float:
         return self.binary[class_id].boost_from_score()
 
@@ -472,6 +495,9 @@ class CrossEntropy(ObjectiveFunction):
 
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-score))
+
+    def convert_output_host(self, score):
+        return 1.0 / (1.0 + np.exp(-score))
 
     def boost_from_score(self, class_id: int = 0) -> float:
         w = self.weight if self.weight is not None else np.ones_like(self.label)
@@ -511,6 +537,9 @@ class CrossEntropyLambda(ObjectiveFunction):
 
     def convert_output(self, score):
         return jnp.log1p(jnp.exp(score))
+
+    def convert_output_host(self, score):
+        return np.log1p(np.exp(score))
 
     def boost_from_score(self, class_id: int = 0) -> float:
         w = self.weight if self.weight is not None else np.ones_like(self.label)

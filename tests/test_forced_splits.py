@@ -99,3 +99,99 @@ def test_forced_respects_max_depth(tmp_path):
     b._gbdt._sync_model()
     t = b._gbdt.models_[0]
     assert t.leaf_depth[:t.num_leaves].max() <= 2
+
+
+def _train_with_forced_wave(tmp_path, forced, n=2048, rounds=2, leaves=8):
+    rng = np.random.RandomState(4)
+    X = rng.rand(n, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n)
+    path = tmp_path / "forced_w.json"
+    path.write_text(json.dumps(forced))
+    b = lgb.train({"objective": "regression", "num_leaves": leaves,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "tpu_growth_strategy": "wave",
+                   "forcedsplits_filename": str(path)},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b._gbdt._sync_model()
+    assert b._gbdt.growth_strategy == "wave"
+    return b
+
+
+def test_wave_root_split_is_forced(tmp_path):
+    """Forced splits now run ON THE WAVE ENGINE (one forced split per
+    prologue wave, wave.py) instead of falling back to leaf-wise."""
+    b = _train_with_forced_wave(tmp_path, {"feature": 2, "threshold": 0.5})
+    for t in b._gbdt.models_:
+        assert t.split_feature[0] == 2
+        assert abs(t.threshold[0] - 0.5) < 0.02
+
+
+def test_wave_nested_forced_matches_leafwise_prefix(tmp_path):
+    forced = {"feature": 2, "threshold": 0.5,
+              "left": {"feature": 1, "threshold": 0.25},
+              "right": {"feature": 1, "threshold": 0.75}}
+    bw = _train_with_forced_wave(tmp_path, forced)
+    bl = _train_with_forced(tmp_path, forced, n=2048)
+    tw, tl = bw._gbdt.models_[0], bl._gbdt.models_[0]
+    # the forced prefix (3 nodes) is engine-independent
+    for s in range(3):
+        assert tw.split_feature[s] == tl.split_feature[s], s
+        assert abs(tw.threshold[s] - tl.threshold[s]) < 1e-9, s
+    assert tw.left_child[0] == 1 and tw.right_child[0] == 2
+
+
+def test_wave_growth_continues_after_forced(tmp_path):
+    b = _train_with_forced_wave(tmp_path, {"feature": 2, "threshold": 0.5},
+                                leaves=16)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 16
+    rng = np.random.RandomState(4)
+    X = rng.rand(2048, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(2048)
+    assert np.corrcoef(b.predict(X), y)[0, 1] > 0.8
+
+
+def test_wave_invalid_forced_split_is_skipped(tmp_path):
+    b = _train_with_forced_wave(tmp_path,
+                                {"feature": 2, "threshold": 99.0},
+                                leaves=8)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 8
+    assert t.split_feature[0] != 2
+
+
+def test_wave_forced_abort_chain(tmp_path):
+    forced = {"feature": 2, "threshold": 99.0,
+              "left": {"feature": 1, "threshold": 0.5},
+              "right": {"feature": 1, "threshold": 0.5}}
+    b = _train_with_forced_wave(tmp_path, forced, leaves=8)
+    t = b._gbdt.models_[0]
+    assert t.num_leaves == 8
+    assert t.split_feature[0] != 2
+
+
+def test_wave_forced_deep_growth_cache_consistency(tmp_path):
+    """Regression: with a forced prologue the ladder's slot bounds must
+    be MULTIPLICATIVE in (KF+1) — the old additive bound undersized the
+    computed-slot kernel from wave ~5 on (>= ~24 splits/wave), silently
+    zero-padding real children and corrupting sibling subtraction.
+    Detectable as leaf counts that no longer partition the rows."""
+    rng = np.random.RandomState(7)
+    n = 16384
+    X = rng.rand(n, 6)
+    y = (X[:, 0] + 2 * X[:, 1] * X[:, 2] + 0.5 * np.sin(6 * X[:, 3])
+         + 0.1 * rng.randn(n))
+    path = tmp_path / "forced_deep.json"
+    path.write_text(json.dumps({"feature": 5, "threshold": 0.5}))
+    b = lgb.train({"objective": "regression", "num_leaves": 96,
+                   "verbosity": -1, "min_data_in_leaf": 2,
+                   "tpu_growth_strategy": "wave",
+                   "forcedsplits_filename": str(path)},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+    b._gbdt._sync_model()
+    for t in b._gbdt.models_:
+        assert t.split_feature[0] == 5
+        assert t.num_leaves >= 64, t.num_leaves
+        # exact row partition: corruption in the cache shows up here
+        assert int(t.leaf_count[:t.num_leaves].sum()) == n
+        assert int(t.internal_count[0]) == n

@@ -70,6 +70,10 @@ def test_wave_auc_within_bound_of_leafwise():
     assert abs(auc_leaf - auc_wave) < 0.002, (auc_leaf, auc_wave)
     auc_plain, _ = _train_auc("wave", wave_prune=False)
     assert abs(auc_leaf - auc_plain) < 0.01, (auc_leaf, auc_plain)
+    # quality mode (spike waves, PERF_NOTES round-5 frontier): within
+    # 0.001 of leaf-wise
+    auc_spike, _ = _train_auc("wave", wave_spike_reserve=16)
+    assert auc_spike > auc_leaf - 0.001, (auc_leaf, auc_spike)
     # both engines spend the full leaf budget on this gain landscape
     mw = b_wave._gbdt.models_[0]
     ml = b_leaf._gbdt.models_[0]
