@@ -112,6 +112,9 @@ def make_sharded_wave_fn(mesh: Mesh):
         return _build(params, keys)(binned, grad, hess, row_mask,
                                     col_mask, meta, *extras)
 
+    # expose the jitted builder so tests can .lower() the EXACT
+    # production shard_map (specs included) for collective accounting
+    call.build = _build
     return call
 
 

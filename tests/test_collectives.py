@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lightgbm_tpu.learner import FeatureMeta, GrowParams, grow_tree_wave
@@ -23,6 +24,19 @@ N = 1 << 13
 F = 8
 B = 64
 L = 31
+
+
+def expected_hist_bytes(L, F, B):
+    """Per-tree psum volume model: one [Kb, F, B, 2] fp32 computed-slot
+    histogram per wave of the subtraction engine's ladder plus the
+    while-loop wave."""
+    from lightgbm_tpu.ops.histogram import wave_slot_pad
+    import math
+    num_waves = max(1, math.ceil(math.log2(L)))
+    kbs = [wave_slot_pad(min(1 << max(k - 1, 0), L))
+           for k in range(num_waves)] + [wave_slot_pad(max(L // 2, 1))]
+    return sum(k * F * B * 2 * 4 for k in kbs)
+
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
@@ -50,18 +64,55 @@ def test_wave_allreduce_count_and_volume():
         binned, grad, hess, mask, cmask, meta, gp).compile().as_text()
     n_ar, bytes_ar = all_reduce_stats(hlo)
 
-    # expected psum volume: one [Kb, F, B, 2] histogram (+ [Kb] counts)
-    # per wave — Kb is the subtraction engine's computed-slot ladder —
-    # plus one [Kb, F, B, 2]-shaped reduction for the while-loop wave and
-    # small scalar reductions (root sums, final count matmul)
-    from lightgbm_tpu.ops.histogram import wave_slot_pad
-    import math
-    num_waves = max(1, math.ceil(math.log2(L)))
-    kbs = [wave_slot_pad(min(1 << max(k - 1, 0), L))
-           for k in range(num_waves)] + [wave_slot_pad(max(L // 2, 1))]
-    hist_bytes = sum(k * F * B * 2 * 4 for k in kbs)
+    # expected psum volume (+ [Kb] counts per wave and small scalar
+    # reductions: root sums, final count matmul)
+    hist_bytes = expected_hist_bytes(L, F, B)
     assert bytes_ar >= hist_bytes, (bytes_ar, hist_bytes)
     # regression bound: within 2x of the pure-histogram volume (scalar
     # side reductions are small) and a fixed op-count envelope
     assert bytes_ar <= 2 * hist_bytes, (bytes_ar, hist_bytes)
     assert n_ar <= 10, n_ar
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_wave_shardmap_allreduce_volume():
+    """The shard_map'd wave path (parallel.make_sharded_wave_fn — the
+    DEFAULT engine's distributed form) must reduce the same computed-slot
+    histograms the GSPMD test above pins: per wave one [Kb, F, B, 2]
+    psum (+ counts + scalar root sums), nothing more.
+
+    Lowered through make_sharded_wave_fn's OWN cached builder, so the
+    production in_specs/out_specs are what compiles.  The CPU test
+    backend lowers the segment histogram inside the shard_map; on TPU
+    the same `_psum` call sites in wave.py wrap the Pallas kernel
+    instead — a pallas_call is shard-local by construction (it cannot
+    emit collectives), so the psum accounting pinned here is the whole
+    cross-device story for both lowerings."""
+    from lightgbm_tpu.parallel import make_sharded_wave_fn
+
+    rng = np.random.RandomState(0)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    binned = rng.randint(0, B, size=(F, N)).astype(np.uint8)
+    grad = rng.randn(N).astype(np.float32)
+    hess = np.abs(rng.rand(N).astype(np.float32)) + 0.1
+    mask = np.ones(N, np.float32)
+    cmask = np.ones(F, bool)
+    meta = FeatureMeta(
+        num_bin=np.full(F, B, np.int32),
+        missing_type=np.zeros(F, np.int32),
+        default_bin=np.zeros(F, np.int32),
+        penalty=np.ones(F, np.float32))
+    gp = GrowParams(num_leaves=L, max_bin=B, hist_method="segment",
+                    split=SplitParams(min_data_in_leaf=20))
+    fn = make_sharded_wave_fn(mesh)
+    # the builder adds data_axis itself (the production path)
+    jitted = fn.build(gp, ())
+    hlo = jitted.lower(jnp.asarray(binned), jnp.asarray(grad),
+                       jnp.asarray(hess), jnp.asarray(mask),
+                       jnp.asarray(cmask), meta).compile().as_text()
+    n_ar, bytes_ar = all_reduce_stats(hlo)
+
+    hist_bytes = expected_hist_bytes(L, F, B)
+    assert bytes_ar >= hist_bytes, (bytes_ar, hist_bytes)
+    assert bytes_ar <= 2 * hist_bytes, (bytes_ar, hist_bytes)
+    assert n_ar <= 12, n_ar
