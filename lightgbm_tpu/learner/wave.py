@@ -466,7 +466,15 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if use_interaction:
             allow = _allowed_of(leaf_branch[:NLp])
             bym = allow if bym is None else (bym & allow)
-        if not incremental_scan or first:
+        # the incremental rescan gathers [2*Kb, Dh] from the cache (XLA
+        # gathers run ~1GB/s) and its cost scales with the STATIC bound
+        # Kb, not realized splits — it only beats the resident full scan
+        # when that bound is a small fraction of NLp.  In practice that
+        # is the spike waves after the first (Kb=8 vs NLp=pad(Lg));
+        # ladder waves, the chain-tail while loop (Kb=pad(Lg/2)), and
+        # short forced prologues all keep the full scan
+        use_inc = incremental_scan and not first and 4 * Kb <= NLp
+        if not use_inc:
             hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
             best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
                            counts, leaf_out[:NLp], *mono_args, rb, rcu,
