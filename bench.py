@@ -13,6 +13,7 @@ num_data, so sec_per_iter_baseline ~ 0.260 * rows / 10.5e6.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -57,7 +58,38 @@ def _auc(y, s):
                  / max(n_pos * n_neg, 1))
 
 
+def _ensure_jax_backend(probe_timeout: float = 180.0) -> bool:
+    """Probe JAX backend init in a THROWAWAY subprocess (jax caches a
+    failed backend init for the process lifetime, so probing in-process
+    would poison this run).  If the configured backend can't come up —
+    BENCH_r05.json showed `RuntimeError: Unable to initialize backend
+    'axon'` killing the whole bench with rc=1 — fall back to CPU with a
+    warning so the bench still emits its JSON line.  Returns True when
+    the fallback was taken."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, text=True, timeout=probe_timeout,
+            env=os.environ.copy())
+        if probe.returncode == 0:
+            return False
+        reason = (probe.stderr or probe.stdout or "").strip().splitlines()
+        reason = reason[-1] if reason else f"exit code {probe.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"backend probe hung for {probe_timeout:.0f}s"
+    print(f"[bench] WARNING: JAX backend unavailable ({reason}); "
+          "falling back to JAX_PLATFORMS=cpu", file=sys.stderr, flush=True)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return True
+
+
 def main():
+    backend_fallback = _ensure_jax_backend()
+    import jax
+    if backend_fallback:
+        # the axon TPU plugin ignores JAX_PLATFORMS; pin explicitly
+        jax.config.update("jax_platforms", "cpu")
+
     import lightgbm_tpu as lgb
 
     X, y = make_higgs_like(ROWS, FEATURES)
@@ -96,7 +128,6 @@ def main():
     # that executes them — carry a pass/fail field every round
     kernel_checks = "skipped"
     try:
-        import jax
         if jax.default_backend() == "tpu":
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
             from tools.kernel_checks import run_checks
@@ -131,6 +162,8 @@ def main():
         "auc": round(auc, 5),
         "iters_trained": WARMUP + ITERS,
         "kernel_checks": kernel_checks,
+        "backend": jax.default_backend(),
+        "backend_fallback": backend_fallback,
     }
     if q_elapsed is not None:
         out["quality_mode_sec_per_iter"] = round(q_elapsed, 4)

@@ -12,10 +12,11 @@ Public surface mirrors python-package/lightgbm/__init__.py.
 __version__ = "0.1.0"
 
 from .basic import Booster, Dataset, Sequence
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+from .callback import (EarlyStopException, checkpoint, early_stopping,
+                       log_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
+from .reliability import CheckpointManager, NonFiniteError
 from .plotting import (create_tree_digraph, plot_importance,
                        plot_metric, plot_split_value_histogram, plot_tree)
 from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
@@ -29,9 +30,12 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "Booster",
     "CVBooster",
+    "CheckpointManager",
     "Config",
     "Dataset",
     "EarlyStopException",
+    "NonFiniteError",
+    "checkpoint",
     "LightGBMError",
     "Sequence",
     "cv",

@@ -549,6 +549,14 @@ class Booster:
             grad, hess = fobj(score if K == 1 else score.T, self._train_set)
             grad = np.asarray(grad, np.float32)
             hess = np.asarray(hess, np.float32)
+            if not (np.all(np.isfinite(grad)) and np.all(np.isfinite(hess))):
+                from .reliability import NonFiniteError
+                raise NonFiniteError(
+                    "Custom objective returned NaN/Inf gradients at "
+                    f"iteration {self._gbdt.current_iteration()}: boosting "
+                    "on non-finite values produces garbage trees. Check the "
+                    "objective for division by zero / log of non-positive "
+                    "values.")
             if K > 1:
                 grad = grad.T.reshape(K, n) if grad.ndim == 2 else grad.reshape(K, n)
                 hess = hess.T.reshape(K, n) if hess.ndim == 2 else hess.reshape(K, n)

@@ -26,6 +26,7 @@ from ..models.tree import Tree
 from ..objective import ObjectiveFunction
 from ..ops.split import SplitParams
 from ..metric import Metric
+from ..reliability import faults
 from ..utils import log
 from ..utils.timer import global_timer
 
@@ -869,6 +870,69 @@ class GBDT:
             vraw = vraw[:, None] if vraw.ndim == 1 else vraw
             self.valid_scores[vi] += vraw.T
 
+    def gradients_finite(self) -> bool:
+        """Fetch the accumulated device-side gradient-finiteness flag
+        (one host sync; called by engine.train's non-finite sentinel)."""
+        flag = getattr(self, "_grad_ok", None)
+        return True if flag is None else bool(flag)
+
+    # ------------------------------------------------------- checkpoint state
+    def capture_train_state(self):
+        """Exact trainer state for CheckpointManager: the float32 score
+        buffer plus the stateful sampling RNGs.  Model text alone is not
+        enough for byte-identical resume — re-seeding scores from
+        predictions differs from the accumulated buffer in ulps, which
+        changes later trees.  Returns None when the scores span
+        non-addressable devices (multi-process SPMD): resume then falls
+        back to predict-based seeding, which is rank-deterministic."""
+        sc = self.scores
+        if isinstance(sc, jax.Array) and not sc.is_fully_addressable:
+            return None
+        state = {"scores": np.asarray(sc),
+                 "num_data": np.int64(self.num_data),
+                 "rng_bag": np.array(self._rng_bag.get_state(legacy=False),
+                                     dtype=object),
+                 "rng_feat": np.array(self._rng_feat.get_state(legacy=False),
+                                      dtype=object),
+                 "bag_mask": np.asarray(self._bag_mask_host)}
+        return state
+
+    def restore_train_state(self, state) -> bool:
+        """Restore a capture_train_state() payload (after continue_from
+        adopted the checkpoint's trees).  Returns True when the exact
+        score buffer was restored."""
+        if state is None:
+            return False
+        ok = False
+        sc = state.get("scores")
+        if sc is not None:
+            sc = np.asarray(sc, np.float32)
+            n = int(state.get("num_data", sc.shape[-1]))
+            if n != self.num_data:
+                log.warning(f"Checkpoint state has {n} rows but the train "
+                            f"set has {self.num_data}; keeping "
+                            "predict-seeded scores")
+            else:
+                # re-pad for this run's mesh (n_pad can differ)
+                self.scores = self._put_by_row(
+                    _pad_rows(sc[:, :n], self.n_pad), axis=1)
+                ok = True
+        for key, rng in (("rng_bag", self._rng_bag),
+                         ("rng_feat", self._rng_feat)):
+            st = state.get(key)
+            if st is not None:
+                try:
+                    rng.set_state(st.item() if hasattr(st, "item") else st)
+                except (ValueError, TypeError) as e:
+                    log.warning(f"Could not restore {key} RNG state: {e}")
+        bm = state.get("bag_mask")
+        if bm is not None and len(bm) >= self.num_data:
+            mask = np.zeros(self.n_pad, np.float32)
+            mask[:self.num_data] = np.asarray(bm, np.float32)[:self.num_data]
+            self._bag_mask_host = mask
+            self.bag_mask = self._put_by_row(mask)
+        return ok
+
     def add_valid_data(self, valid_data: Dataset, name: str,
                        metrics: Sequence[Metric]) -> None:
         self.valid_sets.append(valid_data)
@@ -935,18 +999,22 @@ class GBDT:
         (bag_mask, grad, hess)."""
         cfg = self.config
         n = self.num_data
+        # sampling streams are keyed by the ABSOLUTE iteration so a
+        # checkpoint resume (or init_model continuation) advances the
+        # stream instead of replaying the first run's draws
+        abs_iter = self.num_init_iteration_ + self.iter_
         if cfg.data_sample_strategy == "goss" and grad is not None:
             # not subsampled for the first 1/learning_rate iterations
-            if self.iter_ < int(1.0 / max(cfg.learning_rate, 1e-10)):
+            if abs_iter < int(1.0 / max(cfg.learning_rate, 1e-10)):
                 return self.bag_mask, grad, hess
             top_k = max(1, int(n * cfg.top_rate))
             other_k = max(1, int(n * cfg.other_rate))
-            key = jax.random.PRNGKey(cfg.bagging_seed + self.iter_)
+            key = jax.random.PRNGKey(cfg.bagging_seed + abs_iter)
             mask, grad, hess = _goss_sample(
                 grad, hess, self.pad_mask, key, top_k, other_k)
             return mask, grad, hess
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
-            if self.iter_ % cfg.bagging_freq == 0:
+            if abs_iter % cfg.bagging_freq == 0:
                 pos_frac, neg_frac = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
                 if (pos_frac < 1.0 or neg_frac < 1.0) and self.objective is not None \
                         and self.objective.name == "binary":
@@ -985,11 +1053,26 @@ class GBDT:
         """One boosting iteration; returns True when training should stop
         (ref: gbdt.cpp:338 TrainOneIter)."""
         K = self.num_tree_per_iteration
+        if faults.active():
+            faults.maybe_crash(self.num_init_iteration_ + self.iter_)
         init_scores = [0.0] * K
         if gradients is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
             grad, hess = self._compute_gradients()
+            if faults.active():
+                grad, hess = faults.maybe_nan_grad(
+                    grad, hess, self.num_init_iteration_ + self.iter_)
+            if self.config.nonfinite_check_freq > 0:
+                # device-side finiteness flag, accumulated lazily (no host
+                # sync here); the split program masks NaN gains/values to
+                # zero, so corrupt gradients otherwise degrade the model
+                # SILENTLY.  engine.train fetches the flag every
+                # nonfinite_check_freq iterations (gradients_finite()).
+                ok = (jnp.all(jnp.isfinite(grad))
+                      & jnp.all(jnp.isfinite(hess)))
+                prev = getattr(self, "_grad_ok", None)
+                self._grad_ok = ok if prev is None else (prev & ok)
         else:
             grad = jnp.asarray(_pad_rows(np.asarray(gradients, np.float32)
                                          .reshape(K, -1), self.n_pad))
@@ -1006,9 +1089,13 @@ class GBDT:
                 h_k = self._slice_row_fn(hess, k)
                 if self.use_quant:
                     # per-tree discretization (ref: serial_tree_learner
-                    # BeforeTrain -> DiscretizeGradients on the class slice)
+                    # BeforeTrain -> DiscretizeGradients on the class slice);
+                    # keyed by absolute iteration so resume/continuation
+                    # advances the rounding stream
                     gq, hq, qscales = self._discretize_fn(
-                        g_k, h_k, np.int32(self.iter_ * K + k))
+                        g_k, h_k,
+                        np.int32((self.num_init_iteration_ + self.iter_)
+                                 * K + k))
                 else:
                     gq, hq, qscales = g_k, h_k, None
                 with global_timer.scope("GBDT::grow_tree"):

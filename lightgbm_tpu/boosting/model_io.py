@@ -193,9 +193,12 @@ def load_model_from_string(text: str):
 def save_model_to_file(booster, filename: str, num_iteration: int = -1,
                        start_iteration: int = 0,
                        importance_type: str = "split") -> None:
-    with open(filename, "w") as f:
-        f.write(save_model_to_string(booster, num_iteration, start_iteration,
-                                     importance_type))
+    # atomic: temp sibling + os.replace, so a crash mid-save never leaves
+    # a truncated model on disk (the reference writes model files whole)
+    from ..utils import atomic_write_text
+    atomic_write_text(filename,
+                      save_model_to_string(booster, num_iteration,
+                                           start_iteration, importance_type))
 
 
 def load_model_from_file(filename: str):

@@ -73,6 +73,46 @@ def reset_parameter(**kwargs):
     return _callback
 
 
+def checkpoint(checkpoint_dir: str, frequency: int = 1, keep_last: int = 3,
+               manager=None):
+    """Periodic checkpoint callback: every `frequency` iterations (and at
+    the final iteration) write an atomic, rotated checkpoint of the model
+    plus exact trainer state, resumable via `train(checkpoint_dir=...)`.
+    A failed write warns and training continues — losing one checkpoint
+    must not kill a long run.  Under multi-process SPMD only rank 0
+    writes (all ranks hold identical models by construction)."""
+    from .reliability.checkpoint import CheckpointManager
+    mgr = manager if manager is not None else CheckpointManager(
+        checkpoint_dir, keep_last=keep_last)
+
+    def _is_writer_rank() -> bool:
+        try:
+            import jax
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def _callback(env: CallbackEnv) -> None:
+        if frequency <= 0:
+            return
+        it = env.iteration + 1
+        if it % frequency != 0 and it != env.end_iteration:
+            return
+        if not _is_writer_rank():
+            return
+        if mgr.params_hash is None:
+            from .reliability.checkpoint import hash_params
+            mgr.params_hash = hash_params(env.params)
+        try:
+            mgr.save(env.model, it)
+        except OSError as e:
+            log.warning(f"Checkpoint write failed at iteration {it}: {e}; "
+                        "training continues (the previous checkpoint is "
+                        "intact)")
+    _callback.order = 40
+    return _callback
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True, min_delta: float = 0.0):
     """ref: callback.py early_stopping / _EarlyStoppingCallback."""
@@ -84,8 +124,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         return score < best - min_delta
 
     def _callback(env: CallbackEnv) -> None:
+        if state.get("disabled"):
+            return
         if not env.evaluation_result_list:
-            log.warning("Early stopping requires at least one validation set")
+            # warn ONCE and disable: repeating this every iteration was
+            # pure log spam, and no validation set can appear mid-run
+            log.warning("Early stopping requires at least one validation "
+                        "set; disabling early stopping")
+            state["disabled"] = True
             return
         if not state:
             state["best_score"] = {}

@@ -65,11 +65,23 @@ def _task_train(cfg: Config, params: Dict[str, str]) -> None:
                     f"{cfg.output_model}.snapshot_iter_{it}")
         _snapshot.order = 100
         callbacks = [_snapshot]
+    # task=train resume flags (docs/Reliability.md): checkpoint_dir=DIR
+    # enables rotated atomic checkpoints every checkpoint_freq rounds;
+    # re-running the same command continues from the newest one unless
+    # resume=false.  (Distinct from snapshot_freq, which only writes
+    # model files and never resumes by itself.)
+    if cfg.checkpoint_dir:
+        log.info(f"Checkpointing to {cfg.checkpoint_dir} every "
+                 f"{cfg.checkpoint_freq} iteration(s) "
+                 f"(resume={'on' if cfg.resume else 'off'})")
     booster = train_api(dict(params), train_set,
                         num_boost_round=cfg.num_iterations,
                         valid_sets=valid_sets or None,
                         valid_names=valid_names or None,
-                        init_model=init_model, callbacks=callbacks)
+                        init_model=init_model, callbacks=callbacks,
+                        checkpoint_dir=cfg.checkpoint_dir or None,
+                        checkpoint_freq=cfg.checkpoint_freq,
+                        resume=cfg.resume)
     booster.save_model(cfg.output_model)
     log.info(f"Finished training; model saved to {cfg.output_model}")
 

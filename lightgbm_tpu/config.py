@@ -181,6 +181,15 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     ("output_model", "str", "LightGBM_model.txt", ("model_output", "model_out")),
     ("saved_feature_importance_type", "int", 0, ()),
     ("snapshot_freq", "int", -1, ("save_period",)),
+    # --- reliability (docs/Reliability.md) ---
+    ("checkpoint_dir", "str", "", ("ckpt_dir",)),
+    ("checkpoint_freq", "int", 10, ("checkpoint_frequency", "ckpt_freq")),
+    ("checkpoint_keep", "int", 3, ("checkpoint_keep_last",)),
+    ("resume", "bool", True, ("resume_from_checkpoint",)),
+    ("max_retries", "int", 0, ("num_retries",)),
+    ("retry_backoff", "float", 1.0, ("retry_backoff_base",)),
+    # non-finite sentinel: check train scores every N iterations (0 = off)
+    ("nonfinite_check_freq", "int", 10, ("non_finite_check_freq",)),
     ("use_quantized_grad", "bool", False, ()),
     ("num_grad_quant_bins", "int", 4, ()),
     ("quant_train_renew_leaf", "bool", False, ()),

@@ -57,18 +57,74 @@ def resolve_rank(machines: List[str], local_listen_port: int) -> int:
               "for every worker")
 
 
+def _wait_for_coordinator(address: str, timeout: float) -> None:
+    """Pre-flight TCP probe of the coordinator before handing control to
+    jax.distributed.initialize: this jaxlib's coordination client
+    LOG(FATAL)s (hard process abort, no Python exception) when the
+    coordinator never answers, so the only place to produce a clear
+    diagnostic is BEFORE calling it.  Retries until `timeout` — workers
+    may legitimately start before the coordinator is up."""
+    host, sep, port = address.rpartition(":")
+    if not sep or not port.isdigit():
+        log.fatal(f"Malformed coordinator address {address!r}; expected "
+                  "host:port (the first machine-list entry)")
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return
+        except OSError as e:
+            last_err = e
+            time.sleep(0.5)
+    log.fatal(
+        f"Coordinator {address} is unreachable after {timeout:.0f}s "
+        f"({last_err}). Check that the rank-0 process is running, that "
+        "every worker uses the SAME machine list (entry 0 is the "
+        "coordinator), and that the port is not blocked by a firewall.")
+
+
 def join_cluster(machines, rank: Optional[int] = None,
-                 local_listen_port: int = 12400) -> int:
+                 local_listen_port: int = 12400,
+                 initialize_timeout: Optional[float] = None) -> int:
     """Initialize jax.distributed from a reference-style machine list.
-    Returns this process's rank.  Entry 0 is the coordinator."""
+    Returns this process's rank.  Entry 0 is the coordinator.
+
+    `initialize_timeout` bounds how long a worker waits for the
+    coordinator (seconds; jax's default is 300).  On failure the error
+    names the coordinator address and the usual causes instead of a bare
+    gRPC traceback (or a hard process abort from the coordination
+    client)."""
     if isinstance(machines, str):
         machines = [e.strip() for e in machines.split(",") if e.strip()]
     if rank is None:
         rank = resolve_rank(machines, local_listen_port)
+    if rank != 0:
+        _wait_for_coordinator(machines[0],
+                              timeout=(initialize_timeout
+                                       if initialize_timeout is not None
+                                       else 60.0))
     import jax
-    jax.distributed.initialize(coordinator_address=machines[0],
-                               num_processes=len(machines),
-                               process_id=rank)
+    kwargs = {}
+    if initialize_timeout is not None:
+        kwargs["initialization_timeout"] = int(initialize_timeout)
+    try:
+        jax.distributed.initialize(coordinator_address=machines[0],
+                                   num_processes=len(machines),
+                                   process_id=rank, **kwargs)
+    except TypeError:
+        # older jax without initialization_timeout: join with the default
+        jax.distributed.initialize(coordinator_address=machines[0],
+                                   num_processes=len(machines),
+                                   process_id=rank)
+    except Exception as e:
+        log.fatal(
+            f"Could not join the training cluster as rank "
+            f"{rank}/{len(machines)}: coordinator {machines[0]} is "
+            f"unreachable ({type(e).__name__}: {e}). Check that the rank-0 "
+            "process is running, that every worker uses the SAME machine "
+            "list (entry 0 is the coordinator), and that the port is not "
+            "blocked by a firewall.")
     log.info(f"Joined cluster as rank {rank}/{len(machines)} "
              f"(coordinator {machines[0]})")
     return rank
@@ -80,6 +136,10 @@ spec = json.load(open(sys.argv[1]))
 rank = int(sys.argv[2])
 for k, v in spec.get("env", {}).items():
     os.environ[k] = v
+# fault-injection context: which worker this is and which launch attempt
+# (retried clusters bump the attempt so one-shot faults don't re-fire)
+os.environ["LGBM_TPU_FAULT_SELF_RANK"] = str(rank)
+os.environ["LGBM_TPU_FAULT_ATTEMPT"] = str(spec.get("attempt", 0))
 import jax
 if spec.get("force_cpu"):
     jax.config.update("jax_platforms", "cpu")
@@ -100,19 +160,32 @@ else:
     ds = lgb.Dataset(payload["X"], label=payload.get("y"),
                      weight=payload.get("weight"),
                      group=payload.get("group"), params=params)
+ckpt_dir = spec.get("checkpoint_dir") or None
 booster = lgb.train(params, ds,
-                    num_boost_round=spec["num_boost_round"])
+                    num_boost_round=spec["num_boost_round"],
+                    checkpoint_dir=ckpt_dir,
+                    checkpoint_freq=spec.get("checkpoint_freq", 0),
+                    resume=bool(ckpt_dir))
 if rank == 0:
     booster.save_model(spec["model_out"])
 print(f"worker {rank} done", flush=True)
 """
 
 
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
 def train_distributed(params: Dict[str, Any], data, label=None, *,
                       weight=None, group=None, num_boost_round: int = 100,
                       num_machines: int = 2,
                       worker_env: Optional[Dict[str, str]] = None,
-                      force_cpu: bool = False, timeout: int = 900):
+                      force_cpu: bool = False, timeout: int = 900,
+                      max_retries: int = 0, checkpoint_dir: Optional[str] = None,
+                      checkpoint_freq: int = 0, retry_backoff: float = 1.0,
+                      poll_interval: float = 0.25):
     """Spawn `num_machines` local SPMD workers, train tree_learner=data
     across their combined devices, and return the trained Booster (all
     workers produce identical models; rank 0's is returned).
@@ -122,26 +195,38 @@ def train_distributed(params: Dict[str, Any], data, label=None, *,
     workers through a temp file.  `worker_env` sets per-worker env vars
     (e.g. XLA_FLAGS for virtual-device tests); `force_cpu` pins the CPU
     backend inside the workers.
+
+    Fault tolerance (docs/Reliability.md): workers are SUPERVISED — the
+    first non-zero exit kills the remaining cluster immediately instead
+    of letting the survivors stall in collectives until `timeout`.  With
+    `max_retries > 0` the whole cluster is relaunched with exponential
+    backoff (`retry_backoff * 2**attempt` seconds), resuming from the
+    newest checkpoint; when retries are requested without an explicit
+    `checkpoint_dir`, a per-run directory with checkpoint_freq=1 is used
+    so a retry repeats at most one boosting iteration.
     """
     import shutil
 
     from .basic import Booster
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
     work = tempfile.mkdtemp(prefix="lgbtpu_dist")
     try:
         return _train_distributed_in(
-            work, port, params, data, label, weight, group,
+            work, params, data, label, weight, group,
             num_boost_round, num_machines, worker_env, force_cpu, timeout,
-            Booster)
+            Booster, max_retries=max_retries, checkpoint_dir=checkpoint_dir,
+            checkpoint_freq=checkpoint_freq, retry_backoff=retry_backoff,
+            poll_interval=poll_interval)
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
 
-def _train_distributed_in(work, port, params, data, label, weight, group,
+def _train_distributed_in(work, params, data, label, weight, group,
                           num_boost_round, num_machines, worker_env,
-                          force_cpu, timeout, Booster):
+                          force_cpu, timeout, Booster, *, max_retries=0,
+                          checkpoint_dir=None, checkpoint_freq=0,
+                          retry_backoff=1.0, poll_interval=0.25):
+    from .reliability.supervisor import supervise
+
     data_path = os.path.join(work, "data.pkl")
     with open(data_path, "wb") as f:
         if isinstance(data, (str, os.PathLike)):
@@ -154,45 +239,67 @@ def _train_distributed_in(work, port, params, data, label, weight, group,
                          "group": (None if group is None
                                    else np.asarray(group))}, f)
     model_out = os.path.join(work, "model.txt")
-    spec = {"coordinator": f"localhost:{port}",
-            "num_machines": int(num_machines),
-            "params": dict(params), "num_boost_round": int(num_boost_round),
-            "data": data_path, "model_out": model_out,
-            "repo": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "env": dict(worker_env or {}), "force_cpu": bool(force_cpu)}
-    spec_path = os.path.join(work, "spec.json")
-    with open(spec_path, "w") as f:
-        json.dump(spec, f)
+    if max_retries > 0 and not checkpoint_dir:
+        # retries without checkpoints would replay the whole run; give the
+        # workers a per-run checkpoint dir so a retry loses <= 1 iteration
+        checkpoint_dir = os.path.join(work, "ckpt")
+        if checkpoint_freq <= 0:
+            checkpoint_freq = 1
     script = os.path.join(work, "worker.py")
     with open(script, "w") as f:
         f.write(_WORKER_MAIN)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    # worker output goes to files, not PIPEs: a chatty later-rank worker
-    # filling the ~64KB pipe buffer while an earlier rank still trains
-    # would block inside a collective and stall every rank until timeout
-    log_paths = [os.path.join(work, f"worker_{r}.log")
-                 for r in range(num_machines)]
-    log_files = [open(p, "w") for p in log_paths]
-    procs = [subprocess.Popen([sys.executable, script, spec_path, str(r)],
-                              stdout=log_files[r],
-                              stderr=subprocess.STDOUT, text=True, env=env)
-             for r in range(num_machines)]
-    logs = []
-    ok = True
-    deadline = time.monotonic() + timeout
-    for r, p in enumerate(procs):
+
+    last_failure = "no workers launched"
+    for attempt in range(max_retries + 1):
+        # fresh coordinator port per attempt: the previous coordinator
+        # process is gone and its port may linger in TIME_WAIT
+        port = _free_port()
+        spec = {"coordinator": f"localhost:{port}",
+                "num_machines": int(num_machines),
+                "params": dict(params),
+                "num_boost_round": int(num_boost_round),
+                "data": data_path, "model_out": model_out,
+                "repo": os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))),
+                "env": dict(worker_env or {}), "force_cpu": bool(force_cpu),
+                "attempt": attempt, "checkpoint_dir": checkpoint_dir,
+                "checkpoint_freq": int(checkpoint_freq)}
+        spec_path = os.path.join(work, f"spec_{attempt}.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        # worker output goes to files, not PIPEs: a chatty later-rank worker
+        # filling the ~64KB pipe buffer while an earlier rank still trains
+        # would block inside a collective and stall every rank until timeout
+        log_paths = [os.path.join(work, f"worker_{r}_a{attempt}.log")
+                     for r in range(num_machines)]
+        log_files = [open(p, "w") for p in log_paths]
         try:
-            p.wait(timeout=max(0.0, deadline - time.monotonic()))
-            prefix = ""
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.wait()
-            prefix = "(timeout)\n"
-        log_files[r].close()
-        with open(log_paths[r]) as f:
-            logs.append(prefix + f.read())
-        ok = ok and p.returncode == 0
-    if not ok or not os.path.exists(model_out):
-        log.fatal("distributed training failed:\n" + "\n".join(logs))
-    return Booster(model_file=model_out)
+            procs = [subprocess.Popen(
+                [sys.executable, script, spec_path, str(r)],
+                stdout=log_files[r], stderr=subprocess.STDOUT, text=True,
+                env=env) for r in range(num_machines)]
+            result = supervise(procs, log_paths, timeout,
+                               poll_interval=poll_interval)
+        finally:
+            for lf in log_files:
+                lf.close()
+        if result.ok and os.path.exists(model_out):
+            if attempt > 0:
+                log.info(f"Distributed training succeeded on retry "
+                         f"{attempt} (resumed from {checkpoint_dir})")
+            return Booster(model_file=model_out)
+        last_failure = result.describe() if not result.ok else \
+            "all workers exited 0 but no model file was written"
+        if attempt < max_retries:
+            delay = retry_backoff * (2 ** attempt)
+            log.warning(
+                f"Distributed training attempt {attempt + 1}/"
+                f"{max_retries + 1} failed:\n{last_failure}\n"
+                f"Relaunching the cluster in {delay:.1f}s"
+                + (f", resuming from checkpoints in {checkpoint_dir}"
+                   if checkpoint_dir else ""))
+            time.sleep(delay)
+    log.fatal(f"distributed training failed after {max_retries + 1} "
+              f"attempt(s):\n{last_failure}")

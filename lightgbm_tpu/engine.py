@@ -9,9 +9,43 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from .basic import Booster, Dataset
-from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
+from .callback import (CallbackEnv, EarlyStopException, checkpoint,
+                       early_stopping, log_evaluation)
 from .config import Config
-from .utils import log
+from .reliability import CheckpointManager, NonFiniteError
+from .utils import atomic_write_text, log
+
+
+def _check_finite(booster: Booster, evals, iteration: int,
+                  check_scores: bool) -> None:
+    """Non-finite sentinel (reliability pillar 3): NaN gradients or eval
+    scores mean every subsequent tree is garbage — fail fast instead of
+    silently training on."""
+    for name, metric, value, _ in evals:
+        if value != value:  # NaN
+            raise NonFiniteError(
+                f"Evaluation metric {name} {metric} is NaN at iteration "
+                f"{iteration + 1}. The model scores are corrupt — check the "
+                "objective/labels for invalid values (or resume from a "
+                "checkpoint). Set nonfinite_check_freq=0 to disable this "
+                "sentinel.")
+    if check_scores:
+        if not booster._gbdt.gradients_finite():
+            raise NonFiniteError(
+                f"Non-finite gradients detected at (or before) iteration "
+                f"{iteration + 1}: the split program masks NaN gains to "
+                "zero, so every tree since the corruption is garbage. "
+                "Check the objective/labels for invalid values (or resume "
+                "from a checkpoint). Set nonfinite_check_freq=0 to disable "
+                "this sentinel.")
+        sample = np.asarray(booster._gbdt.scores[:, :256])
+        if not np.all(np.isfinite(sample)):
+            raise NonFiniteError(
+                f"Non-finite training scores detected at iteration "
+                f"{iteration + 1}: the gradients or tree outputs contain "
+                "NaN/Inf. Check the objective, labels and learning_rate "
+                "(or resume from a checkpoint). Set nonfinite_check_freq=0 "
+                "to disable this sentinel.")
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -21,8 +55,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
           feval=None, init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
-          fobj=None) -> Booster:
-    """ref: engine.py:66 train."""
+          fobj=None,
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_freq: Optional[int] = None,
+          resume: Optional[bool] = None) -> Booster:
+    """ref: engine.py:66 train.
+
+    Reliability extensions (docs/Reliability.md): `checkpoint_dir`
+    enables periodic atomic checkpoints every `checkpoint_freq`
+    iterations; with `resume` (default True) a run restarted with the
+    same directory continues from the newest checkpoint instead of from
+    zero, reproducing the uninterrupted run byte-for-byte.  All three
+    can also be given as params (`checkpoint_dir=...` etc.)."""
     params = dict(params or {})
     cfg = Config(params)
     # an explicitly-passed num_iterations (or alias) wins over the function
@@ -30,79 +74,152 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if "num_iterations" in cfg.raw_params:
         num_boost_round = cfg.num_iterations
 
-    booster = Booster(params=params, train_set=train_set)
-    train_in_valid = False
-    valid_wrappers: List[Dataset] = []
-    if valid_sets:
-        for i, vs in enumerate(valid_sets):
-            if vs is train_set:
-                train_in_valid = True
-                continue
-            name = (valid_names[i] if valid_names and i < len(valid_names)
-                    else f"valid_{i}")
-            booster.add_valid(vs, name)
-            valid_wrappers.append(vs)
+    if checkpoint_dir is None:
+        checkpoint_dir = cfg.checkpoint_dir or None
+    if checkpoint_freq is None:
+        checkpoint_freq = cfg.checkpoint_freq
+    if resume is None:
+        resume = cfg.resume
+    ckpt_mgr = (CheckpointManager(checkpoint_dir,
+                                  keep_last=cfg.checkpoint_keep,
+                                  params=params)
+                if checkpoint_dir else None)
 
-    if init_model is not None:
-        # continued training (ref: engine.py init_model -> _InnerPredictor;
-        # the previous model's trees are adopted and its predictions seed the
-        # scores, so the returned booster contains old + new trees)
-        import os
-        if isinstance(init_model, Booster):
-            prev = init_model
-        elif isinstance(init_model, (str, bytes, os.PathLike)):
-            prev = Booster(model_file=os.fspath(init_model))
-        else:
-            log.fatal(f"Unknown init_model type: {type(init_model)}")
+    start_iteration = 0
+    resume_ckpt = None
+    if ckpt_mgr is not None and resume:
+        ck = ckpt_mgr.resumable(params)
+        if ck is not None:
+            if init_model is not None:
+                log.warning("Both init_model and a resumable checkpoint "
+                            "were given; the checkpoint wins")
+            init_model = ck.model_path
+            start_iteration = min(ck.iteration, num_boost_round)
+            resume_ckpt = ck
+            log.info(f"Resuming from checkpoint at iteration {ck.iteration} "
+                     f"({ck.model_path})")
 
-        def _raw_of(ds):
-            d = getattr(ds, "data", None)
-            if d is None or isinstance(d, (str, bytes)):
-                return None
-            return d.values if hasattr(d, "values") else np.asarray(d)
+    user_callbacks = list(callbacks or [])
 
-        booster._gbdt.continue_from(
-            prev._gbdt, train_raw=_raw_of(train_set),
-            valid_raws=[_raw_of(vs) for vs in valid_wrappers])
+    def _build_booster() -> Booster:
+        booster = Booster(params=params, train_set=train_set)
+        booster._train_in_valid = False
+        valid_wrappers: List[Dataset] = []
+        if valid_sets:
+            for i, vs in enumerate(valid_sets):
+                if vs is train_set:
+                    booster._train_in_valid = True
+                    continue
+                name = (valid_names[i] if valid_names and i < len(valid_names)
+                        else f"valid_{i}")
+                booster.add_valid(vs, name)
+                valid_wrappers.append(vs)
 
-    callbacks = list(callbacks or [])
-    if cfg.early_stopping_round > 0 and valid_sets:
-        callbacks.append(early_stopping(cfg.early_stopping_round,
-                                        cfg.first_metric_only,
-                                        verbose=cfg.verbosity >= 1,
-                                        min_delta=cfg.early_stopping_min_delta))
-    if cfg.verbosity >= 1 and cfg.metric_freq > 0:
-        callbacks.append(log_evaluation(cfg.metric_freq))
-    callbacks_before = [cb for cb in callbacks
-                        if getattr(cb, "before_iteration", False)]
-    callbacks_after = [cb for cb in callbacks
-                       if not getattr(cb, "before_iteration", False)]
-    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
-    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+        if init_model is not None:
+            # continued training (ref: engine.py init_model ->
+            # _InnerPredictor; the previous model's trees are adopted and
+            # its predictions seed the scores, so the returned booster
+            # contains old + new trees)
+            import os
+            if isinstance(init_model, Booster):
+                prev = init_model
+            elif isinstance(init_model, (str, bytes, os.PathLike)):
+                prev = Booster(model_file=os.fspath(init_model))
+            else:
+                log.fatal(f"Unknown init_model type: {type(init_model)}")
 
-    booster.best_iteration = -1
-    train_has_metric = bool(cfg.is_provide_training_metric) or train_in_valid
-    try:
-        for i in range(num_boost_round):
-            env = CallbackEnv(model=booster, params=params, iteration=i,
-                              begin_iteration=0, end_iteration=num_boost_round,
-                              evaluation_result_list=[])
-            for cb in callbacks_before:
-                cb(env)
-            stopped = booster.update(fobj=fobj)
-            if stopped:
-                break
-            evals = []
-            if train_has_metric:
-                evals.extend(booster.eval_train(feval))
-            evals.extend(booster.eval_valid(feval))
-            env.evaluation_result_list = evals
-            for cb in callbacks_after:
-                cb(env)
-    except EarlyStopException as e:
-        booster.best_iteration = e.best_iteration + 1
-        for name, metric, value, _ in e.best_score:
-            booster.best_score.setdefault(name, {})[metric] = value
+            def _raw_of(ds):
+                d = getattr(ds, "data", None)
+                if d is None or isinstance(d, (str, bytes)):
+                    return None
+                return d.values if hasattr(d, "values") else np.asarray(d)
+
+            booster._gbdt.continue_from(
+                prev._gbdt, train_raw=_raw_of(train_set),
+                valid_raws=[_raw_of(vs) for vs in valid_wrappers])
+            if resume_ckpt is not None:
+                # checkpoint resume goes beyond init_model: restore the
+                # EXACT score buffer and RNG streams so training continues
+                # as if never interrupted (byte-identical final model)
+                booster._gbdt.restore_train_state(resume_ckpt.load_state())
+        return booster
+
+    rollbacks = 0
+    while True:
+        booster = _build_booster()
+        callbacks = list(user_callbacks)
+        if cfg.early_stopping_round > 0 and valid_sets:
+            callbacks.append(early_stopping(
+                cfg.early_stopping_round, cfg.first_metric_only,
+                verbose=cfg.verbosity >= 1,
+                min_delta=cfg.early_stopping_min_delta))
+        if cfg.verbosity >= 1 and cfg.metric_freq > 0:
+            callbacks.append(log_evaluation(cfg.metric_freq))
+        if ckpt_mgr is not None and checkpoint_freq and checkpoint_freq > 0:
+            callbacks.append(checkpoint(checkpoint_dir,
+                                        frequency=checkpoint_freq,
+                                        manager=ckpt_mgr))
+        callbacks_before = [cb for cb in callbacks
+                            if getattr(cb, "before_iteration", False)]
+        callbacks_after = [cb for cb in callbacks
+                           if not getattr(cb, "before_iteration", False)]
+        callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+        callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+        booster.best_iteration = -1
+        train_has_metric = (bool(cfg.is_provide_training_metric)
+                            or booster._train_in_valid)
+        sentinel_freq = max(int(cfg.nonfinite_check_freq), 0)
+        try:
+            for i in range(start_iteration, num_boost_round):
+                env = CallbackEnv(model=booster, params=params, iteration=i,
+                                  begin_iteration=start_iteration,
+                                  end_iteration=num_boost_round,
+                                  evaluation_result_list=[])
+                for cb in callbacks_before:
+                    cb(env)
+                stopped = booster.update(fobj=fobj)
+                if stopped:
+                    break
+                evals = []
+                if train_has_metric:
+                    evals.extend(booster.eval_train(feval))
+                evals.extend(booster.eval_valid(feval))
+                if sentinel_freq > 0:
+                    # always check right before a checkpoint write, so a
+                    # checkpoint never captures a silently-corrupt model
+                    # (rollback would otherwise resume into the garbage)
+                    will_ckpt = (ckpt_mgr is not None and checkpoint_freq
+                                 and checkpoint_freq > 0
+                                 and ((i + 1) % checkpoint_freq == 0
+                                      or i + 1 == num_boost_round))
+                    _check_finite(
+                        booster, evals, i,
+                        check_scores=((i + 1) % sentinel_freq == 0
+                                      or will_ckpt))
+                env.evaluation_result_list = evals
+                for cb in callbacks_after:
+                    cb(env)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for name, metric, value, _ in e.best_score:
+                booster.best_score.setdefault(name, {})[metric] = value
+        except NonFiniteError as e:
+            ck = ckpt_mgr.resumable(params) if ckpt_mgr is not None else None
+            if ck is None or rollbacks >= 1:
+                raise
+            # roll back: rebuild from the last good checkpoint and re-run
+            # the lost iterations (transient faults don't recur; a
+            # persistent one raises on the second strike)
+            rollbacks += 1
+            log.warning(f"{e}\nRolling back to the checkpoint at iteration "
+                        f"{ck.iteration} and retrying once")
+            init_model = ck.model_path
+            start_iteration = min(ck.iteration, num_boost_round)
+            resume_ckpt = ck
+            continue
+        break
+
     if booster.best_iteration < 0:
         evals = booster.eval_valid(feval)
         for name, metric, value, _ in evals:
@@ -141,9 +258,9 @@ class CVBooster:
 
     def save_model(self, filename, num_iteration=None, start_iteration=0,
                    importance_type="split") -> "CVBooster":
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration,
-                                         importance_type))
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration, start_iteration,
+                                               importance_type))
         return self
 
     def __getattr__(self, name: str):

@@ -1,0 +1,116 @@
+"""Env-driven fault injection.
+
+`LGBM_TPU_FAULT` holds a comma-separated list of `kind@iteration` specs,
+optionally `kind@iteration@attempt` (attempt defaults to 0, matched
+against `LGBM_TPU_FAULT_ATTEMPT` so a supervised retry does not re-fire
+the fault).  Kinds:
+
+* `worker_crash@3`   — `os._exit(17)` at the start of boosting iteration 3
+* `nan_grad@5`       — poison the iteration-5 gradients with NaN
+* `ckpt_write_fail@2`— raise OSError from the iteration-2 checkpoint write
+
+`LGBM_TPU_FAULT_RANK` (optional) restricts firing to one worker: it is
+compared against `LGBM_TPU_FAULT_SELF_RANK`, which the distributed worker
+main sets to its own rank (unset processes count as rank 0).
+
+Each spec fires at most once per process, so an in-process rollback retry
+(engine.train's NaN sentinel) re-runs the poisoned iteration cleanly.
+When `LGBM_TPU_FAULT` is unset every hook is a no-op behind a single
+`active()` check — zero steady-state cost.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from ..utils import log
+
+CRASH_EXIT_CODE = 17
+
+# parsed (kind, iteration, attempt) specs; None = env not parsed yet
+_specs: Optional[List[Tuple[str, int, int]]] = None
+
+_KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail")
+
+
+def _parse() -> List[Tuple[str, int, int]]:
+    raw = os.environ.get("LGBM_TPU_FAULT", "")
+    specs: List[Tuple[str, int, int]] = []
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split("@")
+        if len(parts) not in (2, 3) or parts[0] not in _KINDS:
+            log.warning(f"Ignoring malformed LGBM_TPU_FAULT spec {item!r}; "
+                        f"expected kind@iteration[@attempt] with kind in "
+                        f"{_KINDS}")
+            continue
+        try:
+            it = int(parts[1])
+            attempt = int(parts[2]) if len(parts) == 3 else 0
+        except ValueError:
+            log.warning(f"Ignoring malformed LGBM_TPU_FAULT spec {item!r}: "
+                        "iteration/attempt must be integers")
+            continue
+        specs.append((parts[0], it, attempt))
+    return specs
+
+
+def reload() -> None:
+    """Re-read LGBM_TPU_FAULT (tests change the env mid-process)."""
+    global _specs
+    _specs = None
+
+
+def active() -> bool:
+    global _specs
+    if _specs is None:
+        _specs = _parse()
+    return bool(_specs)
+
+
+def _rank_matches() -> bool:
+    want = os.environ.get("LGBM_TPU_FAULT_RANK")
+    if want is None:
+        return True
+    have = os.environ.get("LGBM_TPU_FAULT_SELF_RANK", "0")
+    return want.strip() == have.strip()
+
+
+def _should_fire(kind: str, iteration: int) -> bool:
+    if not active() or not _rank_matches():
+        return False
+    attempt = int(os.environ.get("LGBM_TPU_FAULT_ATTEMPT", "0"))
+    for i, (k, it, at) in enumerate(_specs):
+        if k == kind and it == iteration and at == attempt:
+            del _specs[i]  # one-shot
+            return True
+    return False
+
+
+def maybe_crash(iteration: int) -> None:
+    """worker_crash hook (boosting update loop / worker main)."""
+    if _should_fire("worker_crash", iteration):
+        print(f"[LGBM_TPU_FAULT] injected worker_crash at iteration "
+              f"{iteration}: exiting {CRASH_EXIT_CODE}", file=sys.stderr,
+              flush=True)
+        os._exit(CRASH_EXIT_CODE)
+
+
+def maybe_nan_grad(grad, hess, iteration: int):
+    """nan_grad hook: returns (grad, hess), poisoned when the spec fires."""
+    if _should_fire("nan_grad", iteration):
+        log.warning(f"[LGBM_TPU_FAULT] injecting NaN gradients at "
+                    f"iteration {iteration}")
+        return grad * float("nan"), hess
+    return grad, hess
+
+
+def maybe_ckpt_write_fail(iteration: int) -> None:
+    """ckpt_write_fail hook, called before the checkpoint touches disk."""
+    if _should_fire("ckpt_write_fail", iteration):
+        raise OSError(f"[LGBM_TPU_FAULT] injected ckpt_write_fail at "
+                      f"iteration {iteration}")

@@ -1,0 +1,101 @@
+"""Worker-process supervision for the multi-process launcher.
+
+The reference engine's socket linkers notice a dead peer quickly; the
+TPU-native launcher's workers instead block inside XLA collectives when
+a peer dies, so the SPAWNING process must watch the children: poll every
+worker, and on the first non-zero exit kill the rest of the cluster
+immediately instead of letting the survivors stall to the global
+timeout (ISSUE: a rank dead at t=0 previously blocked every other rank
+for the full 900 s deadline)."""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class WorkerFailure:
+    rank: int
+    returncode: Optional[int]  # None = killed after timeout
+    log_tail: str
+
+
+@dataclass
+class SuperviseResult:
+    ok: bool
+    timed_out: bool
+    failures: List[WorkerFailure] = field(default_factory=list)
+
+    def describe(self) -> str:
+        if self.ok:
+            return "all workers exited 0"
+        parts = []
+        if self.timed_out:
+            parts.append("cluster hit the launch deadline")
+        for f in self.failures:
+            rc = "killed (timeout)" if f.returncode is None \
+                else f"exit code {f.returncode}"
+            parts.append(f"rank {f.rank} failed ({rc}); log tail:\n"
+                         f"{f.log_tail or '(empty log)'}")
+        return "\n".join(parts)
+
+
+def tail_file(path: str, max_bytes: int = 4096) -> str:
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - max_bytes))
+            data = f.read().decode("utf-8", "replace")
+        if size > max_bytes:
+            data = "...(truncated)...\n" + data
+        return data.strip()
+    except OSError:
+        return "(log unavailable)"
+
+
+def supervise(procs, log_paths: List[str], timeout: float,
+              poll_interval: float = 0.25) -> SuperviseResult:
+    """Watch `procs` until they all exit, one fails, or `timeout` passes.
+
+    On the first non-zero exit the remaining workers are killed at once
+    (they are wedged in collectives waiting for the dead rank).  Always
+    reaps every process before returning."""
+    deadline = time.monotonic() + timeout
+    pending = set(range(len(procs)))
+    failed: List[int] = []
+    timed_out = False
+    while pending:
+        for r in sorted(pending):
+            rc = procs[r].poll()
+            if rc is None:
+                continue
+            pending.discard(r)
+            if rc != 0:
+                failed.append(r)
+        if failed or not pending:
+            break
+        if time.monotonic() >= deadline:
+            timed_out = True
+            break
+        time.sleep(poll_interval)
+
+    for r in pending:  # kill survivors: wedged (peer died) or overdue
+        procs[r].kill()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except Exception:
+            p.kill()
+            p.wait()
+
+    failures = [WorkerFailure(r, procs[r].returncode, tail_file(log_paths[r]))
+                for r in failed]
+    if timed_out:
+        failures.extend(
+            WorkerFailure(r, None, tail_file(log_paths[r]))
+            for r in sorted(pending))
+    ok = not failures and not timed_out
+    return SuperviseResult(ok=ok, timed_out=timed_out, failures=failures)

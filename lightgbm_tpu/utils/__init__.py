@@ -1,5 +1,7 @@
 from . import log
+from .atomic import atomic_write_bytes, atomic_write_text
 from .log import LightGBMError
 from .timer import Timer, global_timer
 
-__all__ = ["log", "LightGBMError", "Timer", "global_timer"]
+__all__ = ["log", "LightGBMError", "Timer", "global_timer",
+           "atomic_write_text", "atomic_write_bytes"]
