@@ -120,8 +120,31 @@ def main():
     elapsed = (time.time() - t0) / ITERS
 
     # quality gate: held-out AUC after the timed iterations (speed must not
-    # be bought with broken trees)
+    # be bought with broken trees).  Measured BEFORE the instrumented
+    # extra iterations below so the tree count matches iters_trained (and
+    # the same-host oracle's iters_lo anchor).
     auc = _auc(yte, booster._gbdt.predict_raw(Xte))
+
+    # phase breakdown (docs/Observability.md): a few EXTRA instrumented
+    # iterations AFTER the timed loop — the timers' phase-boundary syncs
+    # would de-pipeline the dispatch, so the headline number stays
+    # uninstrumented and comparable with every earlier BENCH_*.json
+    from lightgbm_tpu.utils.timer import global_timer
+    timer_prev = global_timer.enabled
+    global_timer.enabled = True
+    global_timer.reset()
+    for _ in range(3):
+        booster.update()
+    _ = np.asarray(booster._gbdt.scores[0][:8])
+    timer_top = [[name, round(sec * 1000, 3), cnt]
+                 for name, sec, cnt in global_timer.items()[:10]]
+    global_timer.enabled = timer_prev
+    global_timer.reset()
+
+    # peak device memory over the run (empty off-TPU: the CPU backend
+    # exposes no memory_stats)
+    from lightgbm_tpu.observability import sample_device_memory
+    mem = sample_device_memory()
 
     # kernel-correctness gate (tools/kernel_checks.py): the Pallas kernel
     # unit tests skip off-TPU, so the driver's chip run is the only CI
@@ -164,7 +187,12 @@ def main():
         "kernel_checks": kernel_checks,
         "backend": jax.default_backend(),
         "backend_fallback": backend_fallback,
+        # where the time goes: [scope, total_ms, calls] over 3
+        # instrumented post-loop iterations (top scopes first)
+        "timer_top_ms": timer_top,
     }
+    if mem.get("device_peak_bytes_in_use") is not None:
+        out["peak_device_bytes"] = mem["device_peak_bytes_in_use"]
     if q_elapsed is not None:
         out["quality_mode_sec_per_iter"] = round(q_elapsed, 4)
         out["quality_mode_auc"] = round(q_auc, 5)

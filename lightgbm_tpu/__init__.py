@@ -13,7 +13,8 @@ __version__ = "0.1.0"
 
 from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, checkpoint, early_stopping,
-                       log_evaluation, record_evaluation, reset_parameter)
+                       log_evaluation, record_evaluation, record_metrics,
+                       reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .reliability import CheckpointManager, NonFiniteError
@@ -42,6 +43,7 @@ __all__ = [
     "early_stopping",
     "log_evaluation",
     "record_evaluation",
+    "record_metrics",
     "register_callback",
     "register_logger",
     "train",

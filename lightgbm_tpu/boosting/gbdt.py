@@ -26,6 +26,7 @@ from ..models.tree import Tree
 from ..objective import ObjectiveFunction
 from ..ops.split import SplitParams
 from ..metric import Metric
+from ..observability import global_registry as _metrics
 from ..reliability import faults
 from ..utils import log
 from ..utils.timer import global_timer
@@ -629,6 +630,13 @@ class GBDT:
                     hist_method="segment")
             self._grow_fn = grow_tree
         self.growth_strategy = strategy
+        # recompile watchdog (docs/Observability.md): a mid-training
+        # shape change on a jitted hot-path entry re-traces the whole
+        # program — a multi-second stall with no other symptom.  The
+        # wrapper warns once per new signature and counts `recompiles`
+        # into the metrics registry.
+        from ..observability import RecompileDetector
+        self._grow_fn = RecompileDetector(self._grow_fn, "grow_tree")
 
         # scores [K, n_pad] on device
         K = self.num_tree_per_iteration
@@ -673,6 +681,9 @@ class GBDT:
                         g, h = objective.get_gradients(sc[0], lab, w)
                         return g[None, :], h[None, :]
                     self._grad_fn_raw = jax.jit(_grad1)
+                from ..observability import RecompileDetector
+                self._grad_fn_raw = RecompileDetector(self._grad_fn_raw,
+                                                      "gradients")
                 self._grad_fn = lambda sc: self._grad_fn_raw(
                     sc, self.label_dev, self.weight_dev)
         for m in self.train_metrics:
@@ -1059,7 +1070,9 @@ class GBDT:
         if gradients is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
-            grad, hess = self._compute_gradients()
+            with global_timer.scope("GBDT::gradients"):
+                grad, hess = self._compute_gradients()
+                grad, hess = global_timer.block((grad, hess))
             if faults.active():
                 grad, hess = faults.maybe_nan_grad(
                     grad, hess, self.num_init_iteration_ + self.iter_)
@@ -1118,6 +1131,7 @@ class GBDT:
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,
                         **grow_kw)
+                    out = global_timer.block(out)
                     if self._lazy_used is not None:
                         arrays, leaf_id, self._lazy_used = out
                     else:
@@ -1130,6 +1144,7 @@ class GBDT:
                     tree = self._finalize_tree(arrays, leaf_id, k,
                                                init_scores[k],
                                                float_grads=(g_k, h_k))
+                _metrics.inc("trees_grown")
             if tree is None:
                 if len(self.models_) < K:
                     tree = self._make_const_stump(k)
@@ -1403,6 +1418,11 @@ class GBDT:
     def _drain_pending(self, keep_depth: int = 0) -> None:
         """Materialize pending device trees oldest-first until at most
         keep_depth remain in flight."""
+        if len(self._pending) > keep_depth:
+            with global_timer.scope("GBDT::materialize_tree"):
+                self._drain_pending_now(keep_depth)
+
+    def _drain_pending_now(self, keep_depth: int) -> None:
         while len(self._pending) > keep_depth:
             p = self._pending.pop(0)
             tree = self._packed_to_tree(_fetch_host(p["packed"]))
@@ -1686,6 +1706,14 @@ class GBDT:
         early stopping per prediction_early_stop.cpp: rows whose margin
         exceeds the threshold every round_period iterations keep their
         partial sum — binary margin = 2|score|, multiclass = top1-top2)."""
+        with global_timer.scope("GBDT::predict"):
+            return self._predict_raw_impl(
+                X, start_iteration, num_iteration, pred_early_stop,
+                pred_early_stop_freq, pred_early_stop_margin)
+
+    def _predict_raw_impl(self, X, start_iteration, num_iteration,
+                          pred_early_stop, pred_early_stop_freq,
+                          pred_early_stop_margin) -> np.ndarray:
         self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         n = X.shape[0]

@@ -5,6 +5,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List
 
+import numpy as np
+
 from .utils import log
 
 
@@ -103,13 +105,86 @@ def checkpoint(checkpoint_dir: str, frequency: int = 1, keep_last: int = 3,
         if mgr.params_hash is None:
             from .reliability.checkpoint import hash_params
             mgr.params_hash = hash_params(env.params)
+        from .observability import emit_event, global_registry
         try:
-            mgr.save(env.model, it)
+            ck = mgr.save(env.model, it)
+            global_registry.inc("checkpoint_writes")
+            emit_event("checkpoint", iteration=it, path=ck.model_path)
         except OSError as e:
+            global_registry.inc("checkpoint_failures")
+            emit_event("checkpoint_write_failed", iteration=it,
+                       error=str(e))
             log.warning(f"Checkpoint write failed at iteration {it}: {e}; "
                         "training continues (the previous checkpoint is "
                         "intact)")
     _callback.order = 40
+    return _callback
+
+
+def record_metrics(metrics_dir: str = None, logger=None):
+    """Structured telemetry callback (docs/Observability.md): appends ONE
+    JSONL event per boosting iteration to
+    `<metrics_dir>/events-rank<r>.jsonl` — iteration wall-clock, the
+    per-phase timer breakdown (delta of `global_timer` since the previous
+    iteration), train/valid eval results, the grown trees' leaf/depth
+    stats, and the cumulative counter/gauge snapshot (checkpoint writes,
+    injected faults, retries, recompiles, device memory).
+
+    `train(metrics_dir=...)` installs this automatically; pass it
+    explicitly (with a shared EventLogger) to co-locate events from
+    custom callbacks.  Phase deltas need the global timer: the engine
+    enables it for metrics runs, or set LIGHTGBM_TPU_TIMETAG=1."""
+    import time as _time
+
+    from .observability import EventLogger, global_registry
+    from .utils.timer import global_timer
+
+    if metrics_dir is None and logger is None:
+        raise ValueError("record_metrics needs metrics_dir or a logger")
+    state: Dict[str, Any] = {"t": _time.perf_counter(),
+                             "snap": global_timer.snapshot()}
+
+    def _callback(env: CallbackEnv) -> None:
+        lg = state.get("logger")
+        if lg is None:
+            lg = logger if logger is not None else EventLogger(metrics_dir)
+            state["logger"] = lg
+        gbdt = env.model._gbdt
+        # materialize this iteration's trees so the event carries their
+        # real shape (and the residual device work is charged to a named
+        # phase instead of leaking into the next iteration's timings)
+        gbdt._drain_pending(keep_depth=0)
+        now = _time.perf_counter()
+        snap = global_timer.snapshot()
+        prev = state["snap"]
+        phases = {}
+        for name, (sec, _cnt) in snap.items():
+            d = sec - prev.get(name, (0.0, 0))[0]
+            if d > 0:
+                phases[name] = round(d, 6)
+        state["snap"] = snap
+        time_s = now - state["t"]
+        state["t"] = now
+
+        train_evals, valid_evals = {}, {}
+        for name, metric, value, _hb in env.evaluation_result_list:
+            if name == "training":
+                train_evals[metric] = value
+            else:
+                valid_evals[f"{name} {metric}"] = value
+        K = gbdt.num_tree_per_iteration
+        trees = []
+        for t in gbdt.models_[-K:] if len(gbdt.models_) >= K else []:
+            nl = int(getattr(t, "num_leaves", 1))
+            depth = (int(np.max(t.leaf_depth[:nl]))
+                     if nl > 1 and hasattr(t, "leaf_depth") else 0)
+            trees.append({"leaves": nl, "depth": depth})
+        reg = global_registry.snapshot()
+        lg.emit("iteration", iteration=env.iteration + 1,
+                time_s=round(time_s, 6), phases=phases,
+                train=train_evals, valid=valid_evals, trees=trees,
+                counters=reg["counters"], gauges=reg["gauges"])
+    _callback.order = 50
     return _callback
 
 

@@ -74,6 +74,15 @@ def _task_train(cfg: Config, params: Dict[str, str]) -> None:
         log.info(f"Checkpointing to {cfg.checkpoint_dir} every "
                  f"{cfg.checkpoint_freq} iteration(s) "
                  f"(resume={'on' if cfg.resume else 'off'})")
+    # observability knobs (docs/Observability.md): metrics_dir= enables
+    # the per-iteration JSONL event log, profile_dir= a jax profiler
+    # trace; both flow to train() through the params dict
+    if cfg.metrics_dir:
+        log.info(f"Writing per-iteration telemetry events to "
+                 f"{cfg.metrics_dir}")
+    if cfg.profile_dir:
+        log.info(f"Profiling run; TensorBoard trace will be written to "
+                 f"{cfg.profile_dir}")
     booster = train_api(dict(params), train_set,
                         num_boost_round=cfg.num_iterations,
                         valid_sets=valid_sets or None,

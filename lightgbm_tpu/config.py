@@ -190,6 +190,12 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     ("retry_backoff", "float", 1.0, ("retry_backoff_base",)),
     # non-finite sentinel: check train scores every N iterations (0 = off)
     ("nonfinite_check_freq", "int", 10, ("non_finite_check_freq",)),
+    # --- observability (docs/Observability.md) ---
+    # structured JSONL event log: one rank-tagged event per iteration
+    ("metrics_dir", "str", "", ("telemetry_dir", "events_dir")),
+    # bracket training with jax.profiler.start_trace/stop_trace for
+    # TensorBoard device timelines
+    ("profile_dir", "str", "", ("trace_dir",)),
     ("use_quantized_grad", "bool", False, ()),
     ("num_grad_quant_bins", "int", 4, ()),
     ("quant_train_renew_leaf", "bool", False, ()),

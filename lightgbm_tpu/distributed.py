@@ -251,6 +251,18 @@ def _train_distributed_in(work, params, data, label, weight, group,
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
 
+    # supervisor-side telemetry: with metrics_dir set, the workers write
+    # their rank-tagged event logs and the parent adds a "supervisor"
+    # stream recording cluster relaunches (docs/Observability.md)
+    evt = None
+    if params.get("metrics_dir"):
+        from .observability import EventLogger
+        try:
+            evt = EventLogger(params["metrics_dir"], rank="supervisor")
+        except OSError as e:
+            log.warning(f"Could not open the supervisor event log in "
+                        f"{params['metrics_dir']}: {e}")
+
     last_failure = "no workers launched"
     for attempt in range(max_retries + 1):
         # fresh coordinator port per attempt: the previous coordinator
@@ -289,11 +301,20 @@ def _train_distributed_in(work, params, data, label, weight, group,
             if attempt > 0:
                 log.info(f"Distributed training succeeded on retry "
                          f"{attempt} (resumed from {checkpoint_dir})")
+                if evt is not None:
+                    evt.emit("cluster_retry_succeeded", attempt=attempt)
             return Booster(model_file=model_out)
         last_failure = result.describe() if not result.ok else \
             "all workers exited 0 but no model file was written"
+        if evt is not None:
+            evt.emit("cluster_attempt_failed", attempt=attempt,
+                     failure=last_failure.splitlines()[0]
+                     if last_failure else "")
         if attempt < max_retries:
             delay = retry_backoff * (2 ** attempt)
+            if evt is not None:
+                evt.emit("cluster_retry", next_attempt=attempt + 1,
+                         delay_s=delay)
             log.warning(
                 f"Distributed training attempt {attempt + 1}/"
                 f"{max_retries + 1} failed:\n{last_failure}\n"

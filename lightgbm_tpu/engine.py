@@ -10,10 +10,11 @@ import numpy as np
 
 from .basic import Booster, Dataset
 from .callback import (CallbackEnv, EarlyStopException, checkpoint,
-                       early_stopping, log_evaluation)
+                       early_stopping, log_evaluation, record_metrics)
 from .config import Config
 from .reliability import CheckpointManager, NonFiniteError
 from .utils import atomic_write_text, log
+from .utils.timer import global_timer
 
 
 def _check_finite(booster: Booster, evals, iteration: int,
@@ -58,7 +59,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
           fobj=None,
           checkpoint_dir: Optional[str] = None,
           checkpoint_freq: Optional[int] = None,
-          resume: Optional[bool] = None) -> Booster:
+          resume: Optional[bool] = None,
+          metrics_dir: Optional[str] = None) -> Booster:
     """ref: engine.py:66 train.
 
     Reliability extensions (docs/Reliability.md): `checkpoint_dir`
@@ -66,7 +68,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     iterations; with `resume` (default True) a run restarted with the
     same directory continues from the newest checkpoint instead of from
     zero, reproducing the uninterrupted run byte-for-byte.  All three
-    can also be given as params (`checkpoint_dir=...` etc.)."""
+    can also be given as params (`checkpoint_dir=...` etc.).
+
+    Observability extensions (docs/Observability.md): `metrics_dir`
+    (also a param) appends a structured JSONL event per iteration —
+    phase timings, eval results, tree stats, checkpoint/fault/retry
+    events — to `<metrics_dir>/events-rank<r>.jsonl`; the `profile_dir`
+    param brackets the run with jax.profiler.start_trace/stop_trace for
+    TensorBoard device timelines."""
     params = dict(params or {})
     cfg = Config(params)
     # an explicitly-passed num_iterations (or alias) wins over the function
@@ -84,6 +93,33 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                   keep_last=cfg.checkpoint_keep,
                                   params=params)
                 if checkpoint_dir else None)
+
+    # ---- observability setup (docs/Observability.md) ----
+    if metrics_dir is None:
+        metrics_dir = cfg.metrics_dir or None
+    profile_dir = cfg.profile_dir or None
+    event_logger = None
+    timer_was_enabled = global_timer.enabled
+    if metrics_dir:
+        from .observability import EventLogger, set_event_logger
+        event_logger = EventLogger(metrics_dir)
+        set_event_logger(event_logger)
+        # the per-iteration phase breakdown diffs global_timer snapshots;
+        # a metrics run therefore always times (restored afterwards)
+        global_timer.enabled = True
+        event_logger.emit("train_start", num_boost_round=num_boost_round,
+                          params=cfg.changed_params())
+    profiling = False
+    if profile_dir:
+        try:
+            import jax
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+            log.info(f"jax profiler trace started; timeline will be "
+                     f"written to {profile_dir}")
+        except Exception as e:  # profiling must never block training
+            log.warning(f"Could not start the jax profiler trace in "
+                        f"{profile_dir}: {e}")
 
     start_iteration = 0
     resume_ckpt = None
@@ -145,86 +181,120 @@ def train(params: Dict[str, Any], train_set: Dataset,
         return booster
 
     rollbacks = 0
-    while True:
-        booster = _build_booster()
-        callbacks = list(user_callbacks)
-        if cfg.early_stopping_round > 0 and valid_sets:
-            callbacks.append(early_stopping(
-                cfg.early_stopping_round, cfg.first_metric_only,
-                verbose=cfg.verbosity >= 1,
-                min_delta=cfg.early_stopping_min_delta))
-        if cfg.verbosity >= 1 and cfg.metric_freq > 0:
-            callbacks.append(log_evaluation(cfg.metric_freq))
-        if ckpt_mgr is not None and checkpoint_freq and checkpoint_freq > 0:
-            callbacks.append(checkpoint(checkpoint_dir,
-                                        frequency=checkpoint_freq,
-                                        manager=ckpt_mgr))
-        callbacks_before = [cb for cb in callbacks
-                            if getattr(cb, "before_iteration", False)]
-        callbacks_after = [cb for cb in callbacks
-                           if not getattr(cb, "before_iteration", False)]
-        callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
-        callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+    try:
+        while True:
+            booster = _build_booster()
+            callbacks = list(user_callbacks)
+            if cfg.early_stopping_round > 0 and valid_sets:
+                callbacks.append(early_stopping(
+                    cfg.early_stopping_round, cfg.first_metric_only,
+                    verbose=cfg.verbosity >= 1,
+                    min_delta=cfg.early_stopping_min_delta))
+            if cfg.verbosity >= 1 and cfg.metric_freq > 0:
+                callbacks.append(log_evaluation(cfg.metric_freq))
+            if ckpt_mgr is not None and checkpoint_freq \
+                    and checkpoint_freq > 0:
+                callbacks.append(checkpoint(checkpoint_dir,
+                                            frequency=checkpoint_freq,
+                                            manager=ckpt_mgr))
+            if event_logger is not None:
+                callbacks.append(record_metrics(logger=event_logger))
+            callbacks_before = [cb for cb in callbacks
+                                if getattr(cb, "before_iteration", False)]
+            callbacks_after = [cb for cb in callbacks
+                               if not getattr(cb, "before_iteration", False)]
+            callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+            callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-        booster.best_iteration = -1
-        train_has_metric = (bool(cfg.is_provide_training_metric)
-                            or booster._train_in_valid)
-        sentinel_freq = max(int(cfg.nonfinite_check_freq), 0)
-        try:
-            for i in range(start_iteration, num_boost_round):
-                env = CallbackEnv(model=booster, params=params, iteration=i,
-                                  begin_iteration=start_iteration,
-                                  end_iteration=num_boost_round,
-                                  evaluation_result_list=[])
-                for cb in callbacks_before:
-                    cb(env)
-                stopped = booster.update(fobj=fobj)
-                if stopped:
-                    break
-                evals = []
-                if train_has_metric:
-                    evals.extend(booster.eval_train(feval))
-                evals.extend(booster.eval_valid(feval))
-                if sentinel_freq > 0:
-                    # always check right before a checkpoint write, so a
-                    # checkpoint never captures a silently-corrupt model
-                    # (rollback would otherwise resume into the garbage)
-                    will_ckpt = (ckpt_mgr is not None and checkpoint_freq
-                                 and checkpoint_freq > 0
-                                 and ((i + 1) % checkpoint_freq == 0
-                                      or i + 1 == num_boost_round))
-                    _check_finite(
-                        booster, evals, i,
-                        check_scores=((i + 1) % sentinel_freq == 0
-                                      or will_ckpt))
-                env.evaluation_result_list = evals
-                for cb in callbacks_after:
-                    cb(env)
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for name, metric, value, _ in e.best_score:
+            booster.best_iteration = -1
+            train_has_metric = (bool(cfg.is_provide_training_metric)
+                                or booster._train_in_valid)
+            sentinel_freq = max(int(cfg.nonfinite_check_freq), 0)
+            try:
+                for i in range(start_iteration, num_boost_round):
+                    env = CallbackEnv(model=booster, params=params,
+                                      iteration=i,
+                                      begin_iteration=start_iteration,
+                                      end_iteration=num_boost_round,
+                                      evaluation_result_list=[])
+                    for cb in callbacks_before:
+                        cb(env)
+                    stopped = booster.update(fobj=fobj)
+                    if stopped:
+                        break
+                    evals = []
+                    with global_timer.scope("GBDT::eval"):
+                        if train_has_metric:
+                            evals.extend(booster.eval_train(feval))
+                        evals.extend(booster.eval_valid(feval))
+                    if sentinel_freq > 0:
+                        if (i + 1) % sentinel_freq == 0:
+                            # device-memory watchdog rides the sentinel
+                            # tick: the HBM gauges land in the registry
+                            # and thus in the next iteration event
+                            from .observability import update_memory_gauges
+                            update_memory_gauges()
+                        # always check right before a checkpoint write, so
+                        # a checkpoint never captures a silently-corrupt
+                        # model (rollback would otherwise resume into the
+                        # garbage)
+                        will_ckpt = (ckpt_mgr is not None and checkpoint_freq
+                                     and checkpoint_freq > 0
+                                     and ((i + 1) % checkpoint_freq == 0
+                                          or i + 1 == num_boost_round))
+                        _check_finite(
+                            booster, evals, i,
+                            check_scores=((i + 1) % sentinel_freq == 0
+                                          or will_ckpt))
+                    env.evaluation_result_list = evals
+                    for cb in callbacks_after:
+                        cb(env)
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for name, metric, value, _ in e.best_score:
+                    booster.best_score.setdefault(name, {})[metric] = value
+            except NonFiniteError as e:
+                ck = (ckpt_mgr.resumable(params) if ckpt_mgr is not None
+                      else None)
+                if ck is None or rollbacks >= 1:
+                    raise
+                # roll back: rebuild from the last good checkpoint and
+                # re-run the lost iterations (transient faults don't
+                # recur; a persistent one raises on the second strike)
+                rollbacks += 1
+                from .observability import emit_event, global_registry
+                global_registry.inc("rollback_retries")
+                emit_event("rollback_retry", from_iteration=ck.iteration,
+                           error=str(e))
+                log.warning(f"{e}\nRolling back to the checkpoint at "
+                            f"iteration {ck.iteration} and retrying once")
+                init_model = ck.model_path
+                start_iteration = min(ck.iteration, num_boost_round)
+                resume_ckpt = ck
+                continue
+            break
+
+        if booster.best_iteration < 0:
+            evals = booster.eval_valid(feval)
+            for name, metric, value, _ in evals:
                 booster.best_score.setdefault(name, {})[metric] = value
-        except NonFiniteError as e:
-            ck = ckpt_mgr.resumable(params) if ckpt_mgr is not None else None
-            if ck is None or rollbacks >= 1:
-                raise
-            # roll back: rebuild from the last good checkpoint and re-run
-            # the lost iterations (transient faults don't recur; a
-            # persistent one raises on the second strike)
-            rollbacks += 1
-            log.warning(f"{e}\nRolling back to the checkpoint at iteration "
-                        f"{ck.iteration} and retrying once")
-            init_model = ck.model_path
-            start_iteration = min(ck.iteration, num_boost_round)
-            resume_ckpt = ck
-            continue
-        break
-
-    if booster.best_iteration < 0:
-        evals = booster.eval_valid(feval)
-        for name, metric, value, _ in evals:
-            booster.best_score.setdefault(name, {})[metric] = value
-    return booster
+        if event_logger is not None:
+            event_logger.emit(
+                "train_end", total_iterations=booster.current_iteration(),
+                best_iteration=booster.best_iteration)
+        return booster
+    finally:
+        global_timer.enabled = timer_was_enabled
+        if profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:
+                log.warning(f"jax profiler stop_trace failed: {e}")
+        if event_logger is not None:
+            from .observability import set_event_logger
+            set_event_logger(None)
+            event_logger.close()
 
 
 class CVBooster:

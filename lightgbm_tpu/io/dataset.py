@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..utils import log
+from ..utils.timer import global_timer
 from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 
 
@@ -284,24 +285,29 @@ class Dataset:
         # pass (io/device_bin.py, exact); otherwise the host searchsorted
         # loop runs per feature.
         from .device_bin import bin_matrix_device, device_binnable
-        if device_binnable(ds.bin_mappers, ds.used_features, data.dtype, n):
-            ds.binned = bin_matrix_device(data, ds.bin_mappers,
-                                          ds.used_features)
-            if reference is None:
-                # keep the (already-sampled) bin-finding rows: EFB
-                # planning bins them lazily on first request
-                # (efb_sample_bins) — gathering sample columns out of
-                # the device matrix costs ~1000x more (tunnel gather),
-                # and eager binning would waste ~2s when bundling is off
-                ds._efb_sample_raw = np.ascontiguousarray(
-                    sample[:, ds.used_features]
-                    if sample.shape[1] != len(ds.used_features)
-                    else sample)
-        else:
-            binned = np.empty((len(ds.used_features), n), dtype=np.int32)
-            for inner, f in enumerate(ds.used_features):
-                binned[inner] = ds.bin_mappers[f].values_to_bins(data[:, f])
-            ds.binned = binned
+        with global_timer.scope("Dataset::binning"):
+            if device_binnable(ds.bin_mappers, ds.used_features,
+                               data.dtype, n):
+                ds.binned = global_timer.block(bin_matrix_device(
+                    data, ds.bin_mappers, ds.used_features))
+                if reference is None:
+                    # keep the (already-sampled) bin-finding rows: EFB
+                    # planning bins them lazily on first request
+                    # (efb_sample_bins) — gathering sample columns out of
+                    # the device matrix costs ~1000x more (tunnel gather),
+                    # and eager binning would waste ~2s when bundling is
+                    # off
+                    ds._efb_sample_raw = np.ascontiguousarray(
+                        sample[:, ds.used_features]
+                        if sample.shape[1] != len(ds.used_features)
+                        else sample)
+            else:
+                binned = np.empty((len(ds.used_features), n),
+                                  dtype=np.int32)
+                for inner, f in enumerate(ds.used_features):
+                    binned[inner] = ds.bin_mappers[f].values_to_bins(
+                        data[:, f])
+                ds.binned = binned
 
         md = Metadata(n)
         if label is not None:
@@ -330,21 +336,25 @@ class Dataset:
         forced_bins = get_forced_bins(forcedbins_filename, num_features,
                                       cat_set)
         self.bin_mappers = []
-        for f in range(num_features):
-            # reference samples *non-zero* values; zeros are implied counts
-            vals = prep_find_bin_values(sample[:, f])
-            mapper = BinMapper()
-            fmax_bin = (int(max_bin_by_feature[f])
-                        if max_bin_by_feature else max_bin)
-            mapper.find_bin(
-                vals, total_sample_cnt, fmax_bin,
-                min_data_in_bin=min_data_in_bin,
-                min_split_data=min_data_in_leaf,
-                pre_filter=feature_pre_filter,
-                bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
-                use_missing=use_missing, zero_as_missing=zero_as_missing,
-                forced_upper_bounds=forced_bins[f])
-            self.bin_mappers.append(mapper)
+        with global_timer.scope("Dataset::find_bin"):
+            for f in range(num_features):
+                # reference samples *non-zero* values; zeros are implied
+                # counts
+                vals = prep_find_bin_values(sample[:, f])
+                mapper = BinMapper()
+                fmax_bin = (int(max_bin_by_feature[f])
+                            if max_bin_by_feature else max_bin)
+                mapper.find_bin(
+                    vals, total_sample_cnt, fmax_bin,
+                    min_data_in_bin=min_data_in_bin,
+                    min_split_data=min_data_in_leaf,
+                    pre_filter=feature_pre_filter,
+                    bin_type=(BIN_CATEGORICAL if f in cat_set
+                              else BIN_NUMERICAL),
+                    use_missing=use_missing,
+                    zero_as_missing=zero_as_missing,
+                    forced_upper_bounds=forced_bins[f])
+                self.bin_mappers.append(mapper)
         self.used_feature_map = []
         self.used_features = []
         for f, m in enumerate(self.bin_mappers):
@@ -477,18 +487,20 @@ class Dataset:
         code_t = np.uint8 if narrow else np.int32
         binned = np.empty((len(ds.used_features), n), dtype=code_t)
         off = 0
-        for feats, _ in stream_factory():
-            feats = np.asarray(feats, np.float64)
-            c = feats.shape[0]
-            if off + c > n:
-                log.fatal("Stream yielded more rows on pass 2 than pass 1")
-            if feats.shape[1] < num_features:   # LibSVM implicit zeros
-                feats = np.pad(
-                    feats, ((0, 0), (0, num_features - feats.shape[1])))
-            for inner, f in enumerate(ds.used_features):
-                binned[inner, off:off + c] = \
-                    ds.bin_mappers[f].values_to_bins(feats[:, f])
-            off += c
+        with global_timer.scope("Dataset::binning"):
+            for feats, _ in stream_factory():
+                feats = np.asarray(feats, np.float64)
+                c = feats.shape[0]
+                if off + c > n:
+                    log.fatal(
+                        "Stream yielded more rows on pass 2 than pass 1")
+                if feats.shape[1] < num_features:   # LibSVM implicit zeros
+                    feats = np.pad(
+                        feats, ((0, 0), (0, num_features - feats.shape[1])))
+                for inner, f in enumerate(ds.used_features):
+                    binned[inner, off:off + c] = \
+                        ds.bin_mappers[f].values_to_bins(feats[:, f])
+                off += c
         if off != n:
             log.fatal(f"Stream yielded {off} rows on pass 2, {n} on pass 1")
         ds.binned = binned

@@ -44,6 +44,7 @@ from ..ops.histogram import build_histogram, build_histogram_rows_pallas
 from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
                          cat_bitset_words, find_best_split,
                          MISSING_NAN, MISSING_ZERO)
+from ..utils.timer import global_timer
 
 
 class FeatureMeta(NamedTuple):
@@ -351,19 +352,24 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         FixHistogram are in hand.  The per-leaf stack and the smaller-
         child subtraction stay in group space (subtraction is linear, so
         group-space subtraction == feature-space subtraction)."""
-        if use_pallas:
-            return build_histogram_rows_pallas(binned.T, gh, member_mask,
-                                               max_bin=hist_B)
-        return build_histogram(binned, gh, member_mask, max_bin=hist_B,
-                               method=params.hist_method)
+        with global_timer.device_scope("Tree::histogram"):
+            if use_pallas:
+                return build_histogram_rows_pallas(binned.T, gh,
+                                                   member_mask,
+                                                   max_bin=hist_B)
+            return build_histogram(binned, gh, member_mask, max_bin=hist_B,
+                                   method=params.hist_method)
 
     def hist_of_rows(rows, gh_sub, member_mask):
         """Histogram over row-major gathered rows [S, F_groups]."""
-        if use_pallas:
-            return build_histogram_rows_pallas(rows, gh_sub, member_mask,
-                                               max_bin=hist_B)
-        return build_histogram(rows.T, gh_sub, member_mask, max_bin=hist_B,
-                               method=params.hist_method)
+        with global_timer.device_scope("Tree::histogram"):
+            if use_pallas:
+                return build_histogram_rows_pallas(rows, gh_sub,
+                                                   member_mask,
+                                                   max_bin=hist_B)
+            return build_histogram(rows.T, gh_sub, member_mask,
+                                   max_bin=hist_B,
+                                   method=params.hist_method)
 
     def mono_penalty_of(depth):
         """ref: monotone_constraints.hpp:357 ComputeMonotoneSplitGainPenalty."""
@@ -493,11 +499,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if sp.has_cegb:
             kw["cegb_coupled"] = meta.cegb_coupled
             kw["cegb_used"] = used
-        return find_best_split(to_feature_hist(hist, sum_g, sum_h),
-                               meta.num_bin, meta.missing_type,
-                               meta.default_bin, meta.penalty, cm,
-                               sum_g, sum_h, cnt, parent_out, sp,
-                               is_cat_feature=meta.is_cat, **kw)
+        with global_timer.device_scope("Tree::split_find"):
+            return find_best_split(to_feature_hist(hist, sum_g, sum_h),
+                                   meta.num_bin, meta.missing_type,
+                                   meta.default_bin, meta.penalty, cm,
+                                   sum_g, sum_h, cnt, parent_out, sp,
+                                   is_cat_feature=meta.is_cat, **kw)
 
     # pow2 bucket ladder for the partitioned engine; the last bucket covers
     # the whole row range (used by the root split)
@@ -677,9 +684,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         branches = [make_branch(S) for S in bucket_sizes]
         k = jnp.searchsorted(jnp.asarray(bucket_sizes, jnp.int32), seg_cnt)
         k = jnp.minimum(k, len(bucket_sizes) - 1)
-        (order, leaf_id, small_hist, cnt_l, cnt_r, cl_seg,
-         smaller_is_left) = jax.lax.switch(k, branches,
-                                           (st.order, st.leaf_id))
+        with global_timer.device_scope("Tree::partition"):
+            (order, leaf_id, small_hist, cnt_l, cnt_r, cl_seg,
+             smaller_is_left) = jax.lax.switch(k, branches,
+                                               (st.order, st.leaf_id))
         leaf_start = st.leaf_start.at[new_leaf].set(start + cl_seg)
         leaf_seg_cnt = (st.leaf_seg_cnt.at[best_leaf].set(cl_seg)
                         .at[new_leaf].set(seg_cnt - cl_seg))
@@ -689,15 +697,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def mask_and_hist(st: _State, best_leaf, new_leaf, feat, thr, dleft,
                       isc, bitset):
         """Masked engine: recolor by scanning all rows (data-parallel safe)."""
-        col = meta.group[feat] if params.has_bundles else feat
-        fbins = jnp.take(binned, col, axis=0).astype(jnp.int32)
-        gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
-        in_leaf = st.leaf_id == best_leaf
-        leaf_id = jnp.where(in_leaf & ~gl, new_leaf, st.leaf_id)
-        lmaskf = (in_leaf & gl).astype(f32) * row_mask
-        rmaskf = (in_leaf & ~gl).astype(f32) * row_mask
-        cnt_l = jnp.sum(lmaskf).astype(jnp.int32)
-        cnt_r = jnp.sum(rmaskf).astype(jnp.int32)
+        with global_timer.device_scope("Tree::partition"):
+            col = meta.group[feat] if params.has_bundles else feat
+            fbins = jnp.take(binned, col, axis=0).astype(jnp.int32)
+            gl = go_left_of(fbins, feat, dleft, thr, isc, bitset)
+            in_leaf = st.leaf_id == best_leaf
+            leaf_id = jnp.where(in_leaf & ~gl, new_leaf, st.leaf_id)
+            lmaskf = (in_leaf & gl).astype(f32) * row_mask
+            rmaskf = (in_leaf & ~gl).astype(f32) * row_mask
+            cnt_l = jnp.sum(lmaskf).astype(jnp.int32)
+            cnt_r = jnp.sum(rmaskf).astype(jnp.int32)
         smaller_is_left = cnt_l <= cnt_r
         if params.use_hist_stack:
             small_mask = jnp.where(smaller_is_left, lmaskf, rmaskf)

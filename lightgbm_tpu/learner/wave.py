@@ -53,6 +53,7 @@ from ..ops.split import (K_MIN_SCORE, SplitResult, cat_bitset_words,
                          find_best_split)
 from .grow import (FeatureMeta, GrowParams, TreeArrays,
                    bundle_hist_to_features, gather_forced_split)
+from ..utils.timer import global_timer
 
 
 def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
@@ -113,8 +114,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # needs no synchronization — the reference's SyncUpGlobalBestSplit
     # (:441) becomes a no-op by construction.
     def _psum(x):
-        return (jax.lax.psum(x, params.data_axis)
-                if params.data_axis is not None else x)
+        if params.data_axis is None:
+            return x
+        # the collective replacing the reference's Network::ReduceScatter
+        # of histograms (data_parallel_tree_learner.cpp:282-295); tagged
+        # so profiler timelines show time-in-collectives per wave
+        with global_timer.device_scope("Network::psum"):
+            return jax.lax.psum(x, params.data_axis)
 
     use_int8 = (use_pallas and params.quant_bins > 0
                 and quant_scales is not None)
@@ -142,32 +148,34 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         `true_slots` (<= num_slots) is the unpadded computed-slot bound:
         when it is small the decomposed hi/lo kernel streams far less
         VMEM volume (ops/histogram.py _wave_kernel_hl)."""
-        if use_pallas:
-            if use_int8:
-                # quantized grid grads -> exact int32 accumulation through
-                # the MXU int8 path (ref: dense_bin.hpp:174
-                # ConstructHistogramIntInner)
-                H, cnt = build_histogram_wave(
-                    binned, kslot, ghm, max_bin=hist_B,
-                    num_slots=num_slots, quant_bins=params.quant_bins,
-                    quant_scales=quant_scales)
-            elif (true_slots is not None and binned_rm is not None
-                    and wave_hl_profitable(hist_B, true_slots)
-                    and _hl_fits(true_slots)):
-                H, cnt = build_histogram_wave_hl(
-                    binned, binned_rm, kslot, ghm, max_bin=hist_B,
-                    num_slots=true_slots, out_slots=num_slots)
+        with global_timer.device_scope("Tree::histogram"):
+            if use_pallas:
+                if use_int8:
+                    # quantized grid grads -> exact int32 accumulation
+                    # through the MXU int8 path (ref: dense_bin.hpp:174
+                    # ConstructHistogramIntInner)
+                    H, cnt = build_histogram_wave(
+                        binned, kslot, ghm, max_bin=hist_B,
+                        num_slots=num_slots, quant_bins=params.quant_bins,
+                        quant_scales=quant_scales)
+                elif (true_slots is not None and binned_rm is not None
+                        and wave_hl_profitable(hist_B, true_slots)
+                        and _hl_fits(true_slots)):
+                    H, cnt = build_histogram_wave_hl(
+                        binned, binned_rm, kslot, ghm, max_bin=hist_B,
+                        num_slots=true_slots, out_slots=num_slots)
+                else:
+                    # Rt stays 512: 1024 is ~3% faster on small slot
+                    # counts but exceeds the 16 MB scoped-VMEM limit at
+                    # 128 slots
+                    H, cnt = build_histogram_wave(binned, kslot, ghm,
+                                                  max_bin=hist_B,
+                                                  num_slots=num_slots)
             else:
-                # Rt stays 512: 1024 is ~3% faster on small slot counts
-                # but exceeds the 16 MB scoped-VMEM limit at 128 slots
-                H, cnt = build_histogram_wave(binned, kslot, ghm,
-                                              max_bin=hist_B,
-                                              num_slots=num_slots)
-        else:
-            H, cnt = _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
-                                    num_slots=num_slots)
-        # shard-local histograms -> global (psum is a no-op single-device)
-        return _psum(H), _psum(cnt)
+                H, cnt = _hist_wave_xla(binned, kslot, ghm, max_bin=hist_B,
+                                        num_slots=num_slots)
+            # shard-local -> global (psum is a no-op single-device)
+            return _psum(H), _psum(cnt)
 
     if sp.extra_trees:
         _extra_key = jax.random.PRNGKey(sp.extra_seed)
@@ -476,9 +484,10 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         use_inc = incremental_scan and not first and 4 * Kb <= NLp
         if not use_inc:
             hists = cache_h[:NLp].reshape(NLp, Fh, hist_B, 2)
-            best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
-                           counts, leaf_out[:NLp], *mono_args, rb, rcu,
-                           used_vec, bym)
+            with global_timer.device_scope("Tree::split_find"):
+                best = best_vm(hists, leaf_sum_g[:NLp], leaf_sum_h[:NLp],
+                               counts, leaf_out[:NLp], *mono_args, rb,
+                               rcu, used_vec, bym)
             if incremental_scan:
                 best_state = jax.tree.map(
                     lambda a, u: a.at[:NLp].set(u), best_state, best)
@@ -494,11 +503,13 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             ch = jnp.clip(changed, 0, Lp - 1)
             h_ch = jnp.take(cache_h, ch, axis=0).reshape(
                 2 * Kb, Fh, hist_B, 2)
-            best_ch = best_vm(h_ch, jnp.take(leaf_sum_g, ch),
-                              jnp.take(leaf_sum_h, ch),
-                              jnp.round(jnp.take(cache_c, ch)).astype(i32),
-                              jnp.take(leaf_out, ch), *mono_args,
-                              rb, rcu, used_vec, bym)
+            with global_timer.device_scope("Tree::split_find"):
+                best_ch = best_vm(h_ch, jnp.take(leaf_sum_g, ch),
+                                  jnp.take(leaf_sum_h, ch),
+                                  jnp.round(jnp.take(cache_c, ch))
+                                  .astype(i32),
+                                  jnp.take(leaf_out, ch), *mono_args,
+                                  rb, rcu, used_vec, bym)
             best_state = jax.tree.map(
                 lambda a, u: a.at[changed].set(u, mode="drop"),
                 best_state, best_ch)
@@ -674,11 +685,14 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         nc = packed.shape[1]
         tab = jnp.concatenate([packed & 255, (packed >> 8) & 255,
                                (packed >> 16) & 255], axis=1)
-        oh_rows = (leaf_id[:, None] ==
-                   jnp.arange(NLp, dtype=i32)[None, :]).astype(jnp.bfloat16)
-        got = jax.lax.dot_general(
-            oh_rows, tab.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [n, 3*nc]
+        with global_timer.device_scope("Tree::partition"):
+            oh_rows = (leaf_id[:, None] ==
+                       jnp.arange(NLp, dtype=i32)[None, :]).astype(
+                           jnp.bfloat16)
+            got = jax.lax.dot_general(
+                oh_rows, tab.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [n, 3*nc]
         prow = (got[:, :nc].astype(i32)
                 + (got[:, nc:2 * nc].astype(i32) << 8)
                 + (got[:, 2 * nc:].astype(i32) << 16))

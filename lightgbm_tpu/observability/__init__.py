@@ -1,0 +1,29 @@
+"""Observability subsystem: metrics registry, structured JSONL event
+log, hot-path tracing hooks and training watchdogs
+(docs/Observability.md).
+
+The reference engine's TIMETAG timers print an aggregate table at exit;
+production-scale training additionally needs machine-readable per-
+iteration telemetry (phase timings, eval results, tree stats, checkpoint
+and fault events) that bench.py and the distributed supervisor can
+consume, plus watchdogs for the failure modes unique to the XLA runtime
+(mid-training recompiles, HBM growth).
+
+Knobs:
+  * `train(metrics_dir=...)` / CLI `metrics_dir=` — JSONL event log
+  * `profile_dir=` — brackets training with jax.profiler start/stop_trace
+  * `LIGHTGBM_TPU_TIMETAG=1` — host phase timers (utils/timer.py)
+  * `LIGHTGBM_TPU_TRACE=1` — jax.profiler.TraceAnnotation per scope
+"""
+
+from .events import (EventLogger, emit_event, get_event_logger,
+                     set_event_logger)
+from .registry import MetricsRegistry, global_registry, process_rank
+from .watchdog import (RecompileDetector, sample_device_memory,
+                       update_memory_gauges)
+
+__all__ = [
+    "EventLogger", "emit_event", "get_event_logger", "set_event_logger",
+    "MetricsRegistry", "global_registry", "process_rank",
+    "RecompileDetector", "sample_device_memory", "update_memory_gauges",
+]

@@ -1,0 +1,86 @@
+"""Structured JSONL event log of a training run.
+
+One `EventLogger` per process writes append-only JSON lines to
+`<metrics_dir>/events-rank<r>.jsonl` (rank-tagged so multi-process SPMD
+runs produce one file per rank with no write contention).  Every record
+carries `event`, `ts` (unix seconds) and `rank`; the `iteration` event —
+one per boosting round, emitted by the `record_metrics` callback — adds
+the phase-timing breakdown, eval results, tree shape stats and the
+cumulative counter/gauge snapshot (schema: docs/Observability.md).
+
+A module-level "current logger" lets deep layers (checkpoint writes,
+fault injection, the recompile watchdog) emit events without threading a
+logger handle through every call: `engine.train` installs its logger for
+the duration of the run and `emit_event(...)` is a no-op outside one.
+Writes are flushed per event so a crashed run's log is complete up to
+the failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .registry import process_rank
+
+
+def _json_default(o):
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, (np.floating, np.float32, np.float64)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+class EventLogger:
+    """Append-only JSONL writer for one process of one run."""
+
+    def __init__(self, directory: str, rank=None):
+        self.dir = os.fspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.rank = process_rank() if rank is None else rank
+        self.path = os.path.join(self.dir, f"events-rank{self.rank}.jsonl")
+        self._fh = open(self.path, "a")
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event, "ts": time.time(), "rank": self.rank}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+_current: Optional[EventLogger] = None
+
+
+def set_event_logger(logger: Optional[EventLogger]) -> None:
+    """Install (or clear, with None) the run-scoped event logger that
+    `emit_event` routes to."""
+    global _current
+    _current = logger
+
+
+def get_event_logger() -> Optional[EventLogger]:
+    return _current
+
+
+def emit_event(event: str, **fields) -> None:
+    """Emit through the current run's logger; silently a no-op when no
+    run is recording (so instrumented subsystems cost nothing outside
+    metrics runs)."""
+    if _current is not None:
+        try:
+            _current.emit(event, **fields)
+        except (OSError, ValueError):
+            pass  # a failed telemetry write must never kill training

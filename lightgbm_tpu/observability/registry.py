@@ -1,0 +1,66 @@
+"""Process-wide metrics registry: counters and gauges, rank-tagged.
+
+The training loop, the reliability subsystem and the watchdogs all
+increment into one registry; the per-iteration JSONL event
+(observability/events.py) snapshots it so a run's structured log carries
+the cumulative counter state next to each iteration's phase timings.
+Counter updates are a dict add behind a lock — cheap enough to stay
+unconditionally on (the reference's equivalent state, e.g. the
+HistogramPool hit counters, is likewise always maintained).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+def process_rank() -> int:
+    """This process's rank in a multi-process SPMD cluster (0 when
+    single-process or when jax is not initialized yet)."""
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class MetricsRegistry:
+    """Counters (monotonic) and gauges (last-write-wins)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Number] = {}
+        self._gauges: Dict[str, Number] = {}
+
+    def inc(self, name: str, value: Number = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: Number) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def counter(self, name: str) -> Number:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge(self, name: str, default: Number = None) -> Number:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> Dict[str, Dict[str, Number]]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+# the process-wide registry every subsystem increments into
+global_registry = MetricsRegistry()

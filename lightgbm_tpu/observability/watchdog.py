@@ -1,0 +1,107 @@
+"""Training watchdogs: recompile detection and device-memory sampling.
+
+Recompiles are the silent TPU performance killer: a mid-training shape
+change (a differently-sized eval batch, a resized bagging mask, a new
+static argument) re-traces and re-compiles the whole jitted program — a
+multi-second stall that looks like "training got slow" with no other
+signal.  `RecompileDetector` wraps a jitted entry point, fingerprints
+every call's argument shapes/dtypes (+ static values), and warns ONCE
+per new signature after the first, naming the offending signature.
+
+The device-memory gauge samples `Device.memory_stats()` (absent on the
+CPU backend — the sampler degrades to an empty dict) into the metrics
+registry so the per-iteration event log carries HBM occupancy, the TPU
+analogue of the reference's histogram-pool size accounting.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+from ..utils import log
+from .events import emit_event
+from .registry import global_registry
+
+
+def call_signature(args, kwargs):
+    """Fingerprint of a jitted call: ((shape, dtype), ...) for array
+    leaves plus the static (non-array) leaves' reprs.  Two calls with
+    equal signatures hit the same executable; a new signature re-traces."""
+    import jax
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    arrays, static = [], []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            arrays.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            static.append(repr(leaf))
+    return tuple(arrays), tuple(static)
+
+
+class RecompileDetector:
+    """Wraps a jitted callable; warns once per NEW argument signature
+    after the first call (each one is an XLA re-trace + re-compile)."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+        self._seen = set()
+        functools.update_wrapper(self, fn,
+                                 assigned=("__name__", "__doc__"),
+                                 updated=())
+
+    def __call__(self, *args, **kwargs):
+        sig = call_signature(args, kwargs)
+        if sig not in self._seen:
+            if self._seen:
+                log.warning(
+                    f"{self._name}: input signature changed mid-training — "
+                    f"XLA re-traces and recompiles the program (array "
+                    f"shapes/dtypes now {list(sig[0])}). Recompiles stall "
+                    f"the accelerator for seconds; keep shapes stable "
+                    f"across iterations.")
+                global_registry.inc("recompiles")
+                emit_event("recompile", fn=self._name,
+                           signature=[list(s) for s in sig[0]])
+            self._seen.add(sig)
+        return self._fn(*args, **kwargs)
+
+    @property
+    def signatures_seen(self) -> int:
+        return len(self._seen)
+
+    def __getattr__(self, name):
+        # transparent proxy: expose the wrapped callable's attributes
+        # (e.g. the sharded-wave fn's `.build` used by collective tests)
+        return getattr(self._fn, name)
+
+
+def sample_device_memory() -> Dict[str, int]:
+    """Sum of the local devices' live/peak HBM bytes, or {} when the
+    backend exposes no memory stats (CPU)."""
+    try:
+        import jax
+        all_stats = [d.memory_stats() for d in jax.local_devices()]
+    except Exception:
+        return {}
+    all_stats = [s for s in all_stats if s]
+    if not all_stats:
+        return {}
+    out: Dict[str, int] = {}
+    for src, dst in (("bytes_in_use", "device_bytes_in_use"),
+                     ("peak_bytes_in_use", "device_peak_bytes_in_use"),
+                     ("bytes_limit", "device_bytes_limit")):
+        vals = [s.get(src) for s in all_stats if s.get(src) is not None]
+        if vals:
+            out[dst] = int(sum(vals))
+    return out
+
+
+def update_memory_gauges() -> Dict[str, int]:
+    """Sample device memory into the global registry (the engine calls
+    this on every nonfinite_check_freq tick)."""
+    stats = sample_device_memory()
+    for k, v in stats.items():
+        global_registry.set_gauge(k, v)
+    return stats

@@ -97,32 +97,35 @@ class CheckpointManager:
         """Checkpoint `booster` as of `iteration` completed rounds.
         Raises OSError on write failure (callers decide whether a failed
         checkpoint is fatal; the training callback warns and continues)."""
-        it = int(iteration)
-        faults.maybe_ckpt_write_fail(it)
-        model_txt = booster.model_to_string(num_iteration=-1)
-        state = None
-        gbdt = getattr(booster, "_gbdt", None)
-        if gbdt is not None and hasattr(gbdt, "capture_train_state"):
-            state = gbdt.capture_train_state()
+        from ..utils.timer import global_timer
+        with global_timer.scope("Checkpoint::save"):
+            it = int(iteration)
+            faults.maybe_ckpt_write_fail(it)
+            model_txt = booster.model_to_string(num_iteration=-1)
+            state = None
+            gbdt = getattr(booster, "_gbdt", None)
+            if gbdt is not None and hasattr(gbdt, "capture_train_state"):
+                state = gbdt.capture_train_state()
 
-        model_path = self._name(it, "txt")
-        atomic_write_text(model_path, model_txt)
-        state_path = None
-        if state is not None:
-            state_path = self._name(it, "npz")
-            buf = io.BytesIO()
-            np.savez(buf, **state)
-            atomic_write_bytes(state_path, buf.getvalue())
-        manifest = {"format": _FORMAT, "iteration": it,
-                    "model": os.path.basename(model_path),
-                    "state": (os.path.basename(state_path)
-                              if state_path else None),
-                    "params_hash": self.params_hash}
-        atomic_write_text(os.path.join(self.dir, MANIFEST),
-                          json.dumps(manifest, indent=1))
-        self._rotate()
-        log.debug(f"Checkpoint written at iteration {it} -> {model_path}")
-        return Checkpoint(it, model_path, state_path, self.params_hash)
+            model_path = self._name(it, "txt")
+            atomic_write_text(model_path, model_txt)
+            state_path = None
+            if state is not None:
+                state_path = self._name(it, "npz")
+                buf = io.BytesIO()
+                np.savez(buf, **state)
+                atomic_write_bytes(state_path, buf.getvalue())
+            manifest = {"format": _FORMAT, "iteration": it,
+                        "model": os.path.basename(model_path),
+                        "state": (os.path.basename(state_path)
+                                  if state_path else None),
+                        "params_hash": self.params_hash}
+            atomic_write_text(os.path.join(self.dir, MANIFEST),
+                              json.dumps(manifest, indent=1))
+            self._rotate()
+            log.debug(
+                f"Checkpoint written at iteration {it} -> {model_path}")
+            return Checkpoint(it, model_path, state_path, self.params_hash)
 
     def _rotate(self) -> None:
         models = sorted(glob.glob(os.path.join(self.dir, "ckpt_*.txt")))

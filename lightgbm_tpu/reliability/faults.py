@@ -91,18 +91,30 @@ def _should_fire(kind: str, iteration: int) -> bool:
     return False
 
 
+def _record_injection(kind: str, iteration: int) -> None:
+    """Count the fired fault and put it on the structured event log (the
+    telemetry record every injected fault leaves behind, so a metrics run
+    under LGBM_TPU_FAULT is self-describing)."""
+    from ..observability import emit_event, global_registry
+    global_registry.inc("faults_injected")
+    emit_event("fault_injected", kind=kind, iteration=iteration)
+
+
 def maybe_crash(iteration: int) -> None:
     """worker_crash hook (boosting update loop / worker main)."""
     if _should_fire("worker_crash", iteration):
-        print(f"[LGBM_TPU_FAULT] injected worker_crash at iteration "
-              f"{iteration}: exiting {CRASH_EXIT_CODE}", file=sys.stderr,
-              flush=True)
+        _record_injection("worker_crash", iteration)
+        sys.stderr.write(f"[LGBM_TPU_FAULT] injected worker_crash at "
+                         f"iteration {iteration}: exiting "
+                         f"{CRASH_EXIT_CODE}\n")
+        sys.stderr.flush()
         os._exit(CRASH_EXIT_CODE)
 
 
 def maybe_nan_grad(grad, hess, iteration: int):
     """nan_grad hook: returns (grad, hess), poisoned when the spec fires."""
     if _should_fire("nan_grad", iteration):
+        _record_injection("nan_grad", iteration)
         log.warning(f"[LGBM_TPU_FAULT] injecting NaN gradients at "
                     f"iteration {iteration}")
         return grad * float("nan"), hess
@@ -112,5 +124,6 @@ def maybe_nan_grad(grad, hess, iteration: int):
 def maybe_ckpt_write_fail(iteration: int) -> None:
     """ckpt_write_fail hook, called before the checkpoint touches disk."""
     if _should_fire("ckpt_write_fail", iteration):
+        _record_injection("ckpt_write_fail", iteration)
         raise OSError(f"[LGBM_TPU_FAULT] injected ckpt_write_fail at "
                       f"iteration {iteration}")

@@ -32,10 +32,14 @@ def register_callback(callback: Optional[Callable[[str], None]]) -> None:
     _LogState.callback = callback
 
 
-def register_logger(logger, info_method_name: str = "info",
+def register_logger(logger=None, info_method_name: str = "info",
                     warning_method_name: str = "warning") -> None:
     """Route info/warning output through a custom logger object
-    (ref: python-package basic.py register_logger)."""
+    (ref: python-package basic.py register_logger).  Passing None
+    unregisters the current logger and restores stderr output."""
+    if logger is None:
+        _LogState.logger = None
+        return
     for m in (info_method_name, warning_method_name):
         if not callable(getattr(logger, m, None)):
             raise TypeError(f"Logger must provide '{info_method_name}' and "
@@ -43,6 +47,15 @@ def register_logger(logger, info_method_name: str = "info",
     _LogState.logger = logger
     _LogState.logger_info = info_method_name
     _LogState.logger_warning = warning_method_name
+
+
+def reset() -> None:
+    """Restore default logging state (stderr sink, verbosity 1, no
+    callback/logger) — test runs use this so one test's redirection
+    cannot leak into the next."""
+    _LogState.level = 1
+    _LogState.callback = None
+    _LogState.logger = None
 
 
 def _emit(msg: str, warning: bool = False) -> None:

@@ -5,13 +5,29 @@ TPU-native analogue of the reference's TIMETAG instrumentation
 instantiated as `global_timer` in src/boosting/gbdt.cpp:22 and printed at
 process exit).  Enabled by the LIGHTGBM_TPU_TIMETAG env var (the
 reference's compile-time flag becomes a runtime switch); scopes can also
-emit jax.profiler TraceAnnotations so device timelines in a profiler
-carry the same names.
+emit jax.profiler TraceAnnotations — driven by the LIGHTGBM_TPU_TRACE
+env var or `set_trace_annotations(True)` — so device timelines in a
+profiler carry the same names.
+
+Two scope flavours (docs/Observability.md):
+
+* `scope(name)` — host-side phases (gradients, grow dispatch, finalize,
+  eval, checkpoint I/O).  Wall-clock accumulates per call.  Because jax
+  dispatch is asynchronous, callers of device work should `block()` the
+  phase's outputs inside the scope so the phase is charged for the work
+  it dispatched — `block()` is a no-op when timing is off, so the hot
+  path stays fully pipelined in production.
+* `device_scope(name)` — for code INSIDE jitted programs (histogram
+  build, split find, partition, collectives).  It wraps the traced ops
+  in `jax.named_scope`, so the phase name survives into the compiled
+  XLA program and shows up on profiler timelines; the host-side
+  accumulation only measures trace time (once per compile).
 """
 
 from __future__ import annotations
 
 import atexit
+import functools
 import os
 import time
 from collections import defaultdict
@@ -22,47 +38,91 @@ from typing import Dict, Tuple
 class Timer:
     """Aggregates wall-clock per named scope (ref: common.h:973 Timer)."""
 
-    def __init__(self, enabled: bool = False):
+    def __init__(self, enabled: bool = False,
+                 use_jax_profiler: bool = None):
         self.enabled = enabled
         self._acc: Dict[str, float] = defaultdict(float)
         self._cnt: Dict[str, int] = defaultdict(int)
-        self._use_jax_profiler = False
+        if use_jax_profiler is None:
+            use_jax_profiler = bool(os.environ.get("LIGHTGBM_TPU_TRACE", ""))
+        self._use_jax_profiler = use_jax_profiler
 
+    # ------------------------------------------------------- profiler wiring
+    def set_trace_annotations(self, on: bool) -> None:
+        """Toggle jax.profiler.TraceAnnotation emission from scopes (the
+        runtime form of the LIGHTGBM_TPU_TRACE env switch)."""
+        self._use_jax_profiler = bool(on)
+
+    def trace_annotations_enabled(self) -> bool:
+        return self._use_jax_profiler
+
+    # ---------------------------------------------------------------- scopes
     @contextmanager
     def scope(self, name: str):
         """RAII scope (ref: common.h:1000 FunctionTimer)."""
-        if not self.enabled:
+        use_trace = self._use_jax_profiler
+        if not self.enabled and not use_trace:
             yield
             return
-        if self._use_jax_profiler:
+        ctx = None
+        if use_trace:
             import jax.profiler
             ctx = jax.profiler.TraceAnnotation(name)
-        else:
-            ctx = None
-        t0 = time.perf_counter()
-        if ctx is not None:
             ctx.__enter__()
+        t0 = time.perf_counter()
         try:
             yield
         finally:
             if ctx is not None:
                 ctx.__exit__(None, None, None)
-            self._acc[name] += time.perf_counter() - t0
-            self._cnt[name] += 1
+            if self.enabled:
+                self._acc[name] += time.perf_counter() - t0
+                self._cnt[name] += 1
+
+    @contextmanager
+    def device_scope(self, name: str):
+        """Scope for code traced INSIDE a jitted program: tags the traced
+        ops with jax.named_scope so the phase name reaches the XLA program
+        (and profiler device timelines); host accumulation sees trace time
+        only (once per compile), not per-call device time."""
+        import jax
+        with jax.named_scope(name.replace("::", ".")):
+            with self.scope(name):
+                yield
+
+    def block(self, x):
+        """block_until_ready(x) when timing is on, so the enclosing scope
+        is charged for the device work it dispatched (async dispatch
+        otherwise bills whichever later phase syncs first).  Identity
+        when timing is off — production dispatch stays pipelined."""
+        if not self.enabled or x is None:
+            return x
+        try:
+            import jax
+            return jax.block_until_ready(x)
+        except Exception:
+            return x
 
     def timeit(self, name: str):
         """Decorator form."""
         def deco(fn):
+            @functools.wraps(fn)
             def wrapped(*a, **k):
                 with self.scope(name):
                     return fn(*a, **k)
             return wrapped
         return deco
 
+    # --------------------------------------------------------------- results
     def items(self) -> Tuple[Tuple[str, float, int], ...]:
         return tuple((k, self._acc[k], self._cnt[k])
                      for k in sorted(self._acc, key=self._acc.get,
                                      reverse=True))
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        """Point-in-time copy {name: (seconds, calls)} — per-iteration
+        phase breakdowns diff two snapshots (observability/events)."""
+        return {k: (self._acc[k], self._cnt[k]) for k in self._acc}
 
     def reset(self) -> None:
         self._acc.clear()

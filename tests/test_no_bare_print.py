@@ -1,0 +1,18 @@
+"""Tier-1 lint: no bare print() in the runtime package — all output goes
+through utils.log or the structured event log (ISSUE 2 satellite;
+tools/check_no_bare_print.py)."""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tools.check_no_bare_print import find_bare_prints  # noqa: E402
+
+
+def test_no_bare_print_in_package():
+    violations = find_bare_prints(os.path.join(_REPO, "lightgbm_tpu"))
+    assert violations == [], (
+        "bare print() calls found (route through utils.log or the event "
+        f"log): {violations}")

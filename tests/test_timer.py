@@ -25,6 +25,64 @@ def test_timer_disabled_is_noop():
     assert t.items() == ()
 
 
+def test_timer_reset_and_snapshot():
+    t = Timer(enabled=True)
+    with t.scope("x"):
+        pass
+    snap = t.snapshot()
+    assert set(snap) == {"x"} and snap["x"][1] == 1
+    with t.scope("x"):
+        pass
+    snap2 = t.snapshot()
+    assert snap2["x"][1] == 2 and snap2["x"][0] >= snap["x"][0]
+    t.reset()
+    assert t.items() == () and t.snapshot() == {}
+
+
+def test_timeit_preserves_wrapped_metadata():
+    """Satellite fix: Timer.timeit must not eat __name__/__doc__."""
+    t = Timer(enabled=True)
+
+    @t.timeit("f")
+    def my_fn(a, b=1):
+        """my docstring"""
+        return a + b
+
+    assert my_fn.__name__ == "my_fn"
+    assert my_fn.__doc__ == "my docstring"
+    assert my_fn(2, b=3) == 5
+    assert dict((k, c) for k, _, c in t.items()) == {"f": 1}
+
+
+def test_trace_annotation_switch():
+    """Satellite fix: the jax-profiler flag is drivable — by the
+    LIGHTGBM_TPU_TRACE env at construction and the public setter."""
+    t = Timer(enabled=False)
+    assert t.trace_annotations_enabled() == bool(
+        __import__("os").environ.get("LIGHTGBM_TPU_TRACE", ""))
+    t.set_trace_annotations(True)
+    assert t.trace_annotations_enabled()
+    # scopes still work (and emit TraceAnnotations) with timing off
+    with t.scope("annotated"):
+        pass
+    assert t.items() == ()   # timing stays off
+    t.set_trace_annotations(False)
+    assert not t.trace_annotations_enabled()
+    t2 = Timer(enabled=False, use_jax_profiler=True)
+    assert t2.trace_annotations_enabled()
+
+
+def test_timer_block_passthrough():
+    t = Timer(enabled=False)
+    obj = object()
+    assert t.block(obj) is obj          # disabled: identity
+    t.enabled = True
+    import jax.numpy as jnp
+    arr = jnp.arange(4)
+    out = t.block(arr)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4))
+
+
 def test_global_timer_instruments_training():
     global_timer.enabled = True
     global_timer.reset()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Lint: all runtime output must go through `lightgbm_tpu.utils.log` (or
+the structured event log, observability/events.py), never bare print().
+
+A bare print() in library code bypasses verbosity gating, the
+register_logger/register_callback redirection that the sklearn wrapper
+and embedding applications rely on, and the rank-tagged event log —
+under multi-process SPMD it also interleaves unsynchronized worker
+output.  The reference enforces the same discipline with its Log::
+macros (include/LightGBM/utils/log.h).
+
+Scope: every .py under lightgbm_tpu/ (the runtime package).  Entry-point
+scripts outside the package (bench.py, tools/, examples/) print their
+results by design and are exempt.  Whitelist inside the package:
+
+* utils/log.py           — print() IS the default stderr sink
+* sys.stderr.write(...)  — not flagged (used by the crash-injection
+  marker in reliability/faults.py, which must bypass any registered
+  logger right before os._exit)
+
+Usage: python tools/check_no_bare_print.py [package_dir]
+Exit 1 when violations are found (wired into tier-1 via
+tests/test_no_bare_print.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+WHITELIST = {
+    os.path.join("lightgbm_tpu", "utils", "log.py"),
+}
+
+
+def find_bare_prints(package_dir: str) -> List[Tuple[str, int]]:
+    """(relative_path, lineno) of every bare print() call under
+    `package_dir`, whitelist applied."""
+    root = os.path.dirname(os.path.abspath(package_dir))
+    violations: List[Tuple[str, int]] = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in WHITELIST:
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    violations.append((rel, e.lineno or 0))
+                    continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    violations.append((rel, node.lineno))
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    package_dir = (argv[1] if len(argv) > 1 else
+                   os.path.join(os.path.dirname(
+                       os.path.dirname(os.path.abspath(__file__))),
+                       "lightgbm_tpu"))
+    violations = find_bare_prints(package_dir)
+    for rel, lineno in violations:
+        print(f"{rel}:{lineno}: bare print() — route output through "
+              "utils.log or the event log")
+    if violations:
+        print(f"{len(violations)} bare print() call(s) found")
+        return 1
+    print("OK: no bare print() calls in the runtime package")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
