@@ -2,6 +2,11 @@
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
+`python bench.py --diff A.json B.json` instead compares two saved bench
+lines' per-phase `timer_top_ms` breakdowns (perf-PR review mode,
+ROADMAP PR-2 follow-up): per-scope ms/calls for both runs, delta and
+ratio, plus the headline sec/iter movement.
+
 Baseline anchor (BASELINE.md): reference CPU LightGBM trains Higgs (10.5M rows,
 28 features, num_leaves=255, 500 iters) in 130.094 s => 0.260 s/iter
 (docs/Experiments.rst:110-123).  This bench runs the same config shape on a
@@ -81,6 +86,50 @@ def _ensure_jax_backend(probe_timeout: float = 180.0) -> bool:
           "falling back to JAX_PLATFORMS=cpu", file=sys.stderr, flush=True)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return True
+
+
+def diff_main(path_a, path_b):
+    """Compare two bench JSON lines' timer_top_ms breakdowns per phase.
+
+    The timer_top_ms field is [[scope, total_ms, calls], ...] over the 3
+    instrumented post-loop iterations (docs/Observability.md).  Scopes
+    present in only one run are listed with the other side blank — a
+    new/removed phase is exactly what a perf-PR review needs to see."""
+    runs = []
+    for p in (path_a, path_b):
+        with open(p) as f:
+            runs.append(json.load(f))
+    a, b = runs
+    ta = {name: (ms, cnt) for name, ms, cnt in a.get("timer_top_ms", [])}
+    tb = {name: (ms, cnt) for name, ms, cnt in b.get("timer_top_ms", [])}
+    # keep A's ordering (slowest first), then B-only scopes
+    names = [n for n, _, _ in a.get("timer_top_ms", [])]
+    names += [n for n, _, _ in b.get("timer_top_ms", []) if n not in ta]
+    wn = max([len(n) for n in names] + [5])
+    print(f"{'phase':<{wn}} {'A ms':>10} {'B ms':>10} {'delta':>10} "
+          f"{'ratio':>7}  calls A->B")
+    for n in names:
+        ma, ca = ta.get(n, (None, None))
+        mb, cb = tb.get(n, (None, None))
+        sa = f"{ma:.1f}" if ma is not None else "-"
+        sb = f"{mb:.1f}" if mb is not None else "-"
+        if ma is not None and mb is not None:
+            delta = f"{mb - ma:+.1f}"
+            ratio = f"{mb / ma:.2f}x" if ma > 0 else "-"
+        else:
+            delta, ratio = "-", "-"
+        calls = f"{ca if ca is not None else '-'}" \
+                f"->{cb if cb is not None else '-'}"
+        print(f"{n:<{wn}} {sa:>10} {sb:>10} {delta:>10} {ratio:>7}  {calls}")
+    va, vb = a.get("value"), b.get("value")
+    if va and vb:
+        print(f"headline: {va} -> {vb} {a.get('unit', 's/iter')} "
+              f"({vb / va:.3f}x; {'faster' if vb < va else 'slower'} B)")
+    for key in ("auc", "quality_mode_sec_per_iter", "quality_mode_auc",
+                "peak_device_bytes", "backend"):
+        if a.get(key) is not None or b.get(key) is not None:
+            print(f"{key}: {a.get(key)} -> {b.get(key)}")
+    return 0
 
 
 def main():
@@ -246,4 +295,10 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--diff":
+        if len(sys.argv) != 4:
+            print("usage: python bench.py --diff A.json B.json",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(diff_main(sys.argv[2], sys.argv[3]))
     main()

@@ -808,7 +808,8 @@ class GBDT:
                     ids = jnp.clip(leaf_id, 0, L - 1)
                     sg = jnp.zeros(L, jnp.float32).at[ids].add(grad * mask)
                     sh = jnp.zeros(L, jnp.float32).at[ids].add(hess * mask)
-                    out = leaf_output(sg, sh, jnp.zeros(L), 0.0, renew_p)
+                    out = leaf_output(sg, sh, jnp.zeros(L, jnp.float32),
+                                      0.0, renew_p)
                     return jnp.where(sh > 0, out, leaf_value)
                 self._renew_quant_fn = jax.jit(_renew)
 
@@ -817,7 +818,8 @@ class GBDT:
 
             @jax.jit
             def _cegb_mark(used, split_feature, num_leaves):
-                m = jnp.arange(split_feature.shape[0]) < num_leaves - 1
+                m = (jnp.arange(split_feature.shape[0], dtype=jnp.int32)
+                     < num_leaves - 1)
                 return used.at[jnp.where(m, split_feature, F_used)].set(
                     True, mode="drop")
             self._cegb_mark_fn = _cegb_mark

@@ -193,6 +193,10 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # --- observability (docs/Observability.md) ---
     # structured JSONL event log: one rank-tagged event per iteration
     ("metrics_dir", "str", "", ("telemetry_dir", "events_dir")),
+    # size-based event-log rotation for multi-day runs: when the live
+    # events-rank<r>.jsonl would exceed this many MiB it rolls to .1,
+    # .2, ... (0 disables rotation)
+    ("metrics_rotate_mb", "float", 0.0, ("metrics_rotate_megabytes",)),
     # bracket training with jax.profiler.start_trace/stop_trace for
     # TensorBoard device timelines
     ("profile_dir", "str", "", ("trace_dir",)),

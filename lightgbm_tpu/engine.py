@@ -102,7 +102,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     timer_was_enabled = global_timer.enabled
     if metrics_dir:
         from .observability import EventLogger, set_event_logger
-        event_logger = EventLogger(metrics_dir)
+        event_logger = EventLogger(metrics_dir,
+                                   rotate_mb=cfg.metrics_rotate_mb)
         set_event_logger(event_logger)
         # the per-iteration phase breakdown diffs global_timer snapshots;
         # a metrics run therefore always times (restored afterwards)
@@ -409,12 +410,15 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     out: Dict[str, List[float]] = {}
     for metric in (histories[0].keys() if histories else []):
         rounds = min(len(h.get(metric, [])) for h in histories)
-        mean = [float(np.mean([h[metric][i] for h in histories]))
-                for i in range(rounds)]
-        std = [float(np.std([h[metric][i] for h in histories]))
-               for i in range(rounds)]
-        out[f"valid {metric}-mean"] = mean
-        out[f"valid {metric}-stdv"] = std
+        # one [nfold, rounds] materialization + vectorized reduction:
+        # per-round np.mean/np.std over Python lists converted each fold
+        # value individually — with device-scalar entries that was one
+        # host/device ping-pong per (metric, round, fold) (the first
+        # real finding of the ISSUE 3 no-host-sync sweep outside jit)
+        vals = np.asarray([h.get(metric, [])[:rounds] for h in histories],
+                          dtype=np.float64)
+        out[f"valid {metric}-mean"] = vals.mean(axis=0).tolist()
+        out[f"valid {metric}-stdv"] = vals.std(axis=0).tolist()
     if return_cvbooster:
         cvb = CVBooster()
         cvb.boosters = boosters

@@ -222,7 +222,8 @@ def bundle_hist_to_features(hist_g, sum_g, sum_h, meta: "FeatureMeta",
         hist_f, zb[:, None, None].repeat(2, 2), 1)
     new_zb = jnp.where(meta.in_bundle[:, None], fix, fixed[:, 0, :])
     hist_f = jnp.where(
-        (jnp.arange(B)[None, :, None] == zb[:, None, None]),
+        (jnp.arange(B, dtype=jnp.int32)[None, :, None]
+         == zb[:, None, None]),
         new_zb[:, None, :], hist_f)
     return hist_f
 
@@ -989,13 +990,15 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     def smin(gate, pos):
                         """[L, F, B] scatter-min of out_j at bin pos."""
                         p = jnp.where(gate & (pos >= 0), pos, B_)
-                        return (jnp.full((L, num_features, B_ + 1), inf)
+                        return (jnp.full((L, num_features, B_ + 1), inf,
+                                         f32)
                                 .at[ii, ff, p].min(
                                     jnp.where(gate, ojb, inf))[:, :, :B_])
 
                     def smax(gate, pos):
                         p = jnp.where(gate & (pos >= 0), pos, B_)
-                        return (jnp.full((L, num_features, B_ + 1), -inf)
+                        return (jnp.full((L, num_features, B_ + 1), -inf,
+                                         f32)
                                 .at[ii, ff, p].max(
                                     jnp.where(gate, ojb, -inf))[:, :, :B_])
 

@@ -60,7 +60,8 @@ def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
     """XLA fallback (CPU tests): per-slot masked histograms via one-hot
     einsum.  Small shapes only.  gh's LAST column is the count mask;
     returns (hist [NL, F, B, C], counts [NL]) like the Pallas kernel."""
-    oh_slot = (slot[:, None] == jnp.arange(num_slots)[None, :])  # [n, NL]
+    oh_slot = (slot[:, None]
+               == jnp.arange(num_slots, dtype=jnp.int32)[None, :])  # [n, NL]
     oh_bin = (binned_fm[:, :, None] ==
               jnp.arange(max_bin, dtype=jnp.int32)[None, None, :])  # [F,n,B]
     # [NL, F, B, C]; histograms are exact accumulators, so force fp32
@@ -120,6 +121,7 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # of histograms (data_parallel_tree_learner.cpp:282-295); tagged
         # so profiler timelines show time-in-collectives per wave
         with global_timer.device_scope("Network::psum"):
+            # tpulint: disable-next=collective-discipline -- the wave engine's single histogram/count reduction point; parallel/data_parallel.py wraps this engine in shard_map and owns the data_axis contract
             return jax.lax.psum(x, params.data_axis)
 
     use_int8 = (use_pallas and params.quant_bins > 0
@@ -568,7 +570,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # splitting leaf now point at that leaf's new internal node
         def fix_child(child):
             ll = jnp.where(child < 0, ~child, 0)
-            is_leaf_ref = (child < 0) & (jnp.arange(ni) < NL - 1)
+            is_leaf_ref = (child < 0) & (jnp.arange(ni, dtype=i32)
+                                         < NL - 1)
             repl = jnp.take(node_of, jnp.clip(ll, 0, NLp - 1))
             hit = is_leaf_ref & jnp.take(split_sel, jnp.clip(ll, 0, NLp - 1))
             return jnp.where(hit, repl, child)

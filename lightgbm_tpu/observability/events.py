@@ -14,6 +14,13 @@ logger handle through every call: `engine.train` installs its logger for
 the duration of the run and `emit_event(...)` is a no-op outside one.
 Writes are flushed per event so a crashed run's log is complete up to
 the failure.
+
+Multi-day runs: `rotate_mb` (param `metrics_rotate_mb`, 0 = off) caps
+the live file's size — when an emit would push `events-rank<r>.jsonl`
+past the cap, existing rollovers shift up (`.1` -> `.2`, ...), the live
+file becomes `.1`, and a fresh live file is opened.  Newest events are
+always in the unsuffixed file; history is unbounded by design (the
+operator prunes old `.N` files, the logger never deletes data).
 """
 
 from __future__ import annotations
@@ -41,17 +48,36 @@ def _json_default(o):
 class EventLogger:
     """Append-only JSONL writer for one process of one run."""
 
-    def __init__(self, directory: str, rank=None):
+    def __init__(self, directory: str, rank=None, rotate_mb: float = 0):
         self.dir = os.fspath(directory)
         os.makedirs(self.dir, exist_ok=True)
         self.rank = process_rank() if rank is None else rank
         self.path = os.path.join(self.dir, f"events-rank{self.rank}.jsonl")
+        self.rotate_bytes = int(float(rotate_mb) * (1 << 20))
+        self._fh = open(self.path, "a")
+
+    def _rotate(self) -> None:
+        """Shift events-rank<r>.jsonl -> .1 -> .2 -> ... and reopen."""
+        self._fh.close()
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        for i in range(n, 1, -1):
+            os.replace(f"{self.path}.{i - 1}", f"{self.path}.{i}")
+        os.replace(self.path, f"{self.path}.1")
         self._fh = open(self.path, "a")
 
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event, "ts": time.time(), "rank": self.rank}
         rec.update(fields)
-        self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+        line = json.dumps(rec, default=_json_default) + "\n"
+        if self.rotate_bytes > 0 and self._fh.tell() \
+                and self._fh.tell() + len(line) > self.rotate_bytes:
+            try:
+                self._rotate()
+            except OSError:
+                pass  # a failed rotation must never kill training
+        self._fh.write(line)
         self._fh.flush()
 
     def close(self) -> None:

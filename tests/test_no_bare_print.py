@@ -1,6 +1,11 @@
-"""Tier-1 lint: no bare print() in the runtime package — all output goes
-through utils.log or the structured event log (ISSUE 2 satellite;
-tools/check_no_bare_print.py)."""
+"""Tier-1 lint shim: no bare print() in the runtime package.
+
+The standalone checker (tools/check_no_bare_print.py, ISSUE 2) was
+retired in favor of the tpulint rule of the same name (ISSUE 3,
+tools/tpulint/rules/bare_print.py — same whitelist and rationale).
+This file stays so the historical tier-1 entry keeps passing; the full
+suite (all rules) runs in tests/test_tpulint.py.
+"""
 
 import os
 import sys
@@ -8,11 +13,12 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from tools.check_no_bare_print import find_bare_prints  # noqa: E402
+from tools.tpulint import run_lint  # noqa: E402
 
 
 def test_no_bare_print_in_package():
-    violations = find_bare_prints(os.path.join(_REPO, "lightgbm_tpu"))
-    assert violations == [], (
+    report = run_lint(os.path.join(_REPO, "lightgbm_tpu"),
+                      rules=["no-bare-print"])
+    assert report.active == [], (
         "bare print() calls found (route through utils.log or the event "
-        f"log): {violations}")
+        f"log): {[f.render() for f in report.active]}")

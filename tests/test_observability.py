@@ -132,6 +132,47 @@ def test_record_metrics_requires_sink():
         lgb.record_metrics()
 
 
+def test_event_log_size_rotation(tmp_path):
+    """metrics_rotate_mb (ISSUE 3 satellite): when the live file would
+    exceed the cap it rolls to .1, .2, ... oldest-highest, the live file
+    always holds the newest events, and no event is lost."""
+    md = str(tmp_path / "rot")
+    # ~1 KiB cap => every few ~120-byte events rotate the file
+    logger = EventLogger(md, rank=0, rotate_mb=1.0 / 1024)
+    n = 50
+    for i in range(n):
+        logger.emit("tick", i=i, pad="x" * 80)
+    logger.close()
+    base = os.path.join(md, "events-rank0.jsonl")
+    rolls = sorted(f for f in os.listdir(md) if f != "events-rank0.jsonl")
+    assert rolls, "a 1KiB cap over ~6KiB of events must have rotated"
+    assert all(f.startswith("events-rank0.jsonl.") for f in rolls)
+    # every roll respects the cap; chronology: .N oldest ... .1, then live
+    order = sorted((int(f.rsplit(".", 1)[1]) for f in rolls), reverse=True)
+    seen = []
+    for idx in order:
+        p = f"{base}.{idx}"
+        assert os.path.getsize(p) <= 1024
+        seen += [json.loads(line)["i"] for line in open(p) if line.strip()]
+    seen += [json.loads(line)["i"] for line in open(base) if line.strip()]
+    assert seen == list(range(n)), "rotation lost or reordered events"
+
+
+def test_event_log_rotation_via_train_param(tmp_path):
+    """The metrics_rotate_mb param reaches the engine's EventLogger."""
+    X, y = _data(n=200)
+    md = str(tmp_path / "metrics")
+    lgb.train({"objective": "regression", "num_leaves": 4,
+               "verbosity": -1, "metric": "l2",
+               "metrics_rotate_mb": 1.0 / 1024},
+              lgb.Dataset(X, label=y), num_boost_round=8,
+              metrics_dir=md)
+    names = os.listdir(md)
+    assert "events-rank0.jsonl" in names
+    assert any(n.startswith("events-rank0.jsonl.") for n in names), (
+        f"expected rotated files under a 1KiB cap, got {names}")
+
+
 # ------------------------------------------------------ recompile watchdog
 def test_recompile_detector_warns_once_per_new_signature():
     """Acceptance: exactly one warning per NEW shape signature after the

@@ -1,0 +1,358 @@
+"""tpulint static-analysis suite (ISSUE 3 tentpole).
+
+Two layers:
+
+* fixture tests — for every rule, at least one true positive and one
+  true negative over a synthetic mini-package, pinning the analysis
+  contract (what taints, what is static, what is in scope);
+* package tests — the full suite over the real `lightgbm_tpu` tree
+  must report ZERO unsuppressed findings (the merge bar), and every
+  suppression must carry a justification.
+
+No jax import needed: the lint is pure-AST by design, so this file is
+cheap tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tools.tpulint import RULES, run_lint  # noqa: E402
+
+PACKAGE = os.path.join(_REPO, "lightgbm_tpu")
+
+
+def _mk_pkg(tmp_path, files):
+    """Write {relpath: source} under tmp_path/pkg and return its path."""
+    pkg = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    # ensure __init__.py files exist so the tree looks like a package
+    for root, _dirs, _files in os.walk(pkg):
+        init = os.path.join(root, "__init__.py")
+        if not os.path.exists(init):
+            open(init, "w").close()
+    return str(pkg)
+
+
+def _lint(tmp_path, files, rules):
+    return run_lint(_mk_pkg(tmp_path, files), rules=rules)
+
+
+def _rules_of(report):
+    return [(f.path.split(os.sep, 1)[1], f.line, f.rule)
+            for f in report.active]
+
+
+# ------------------------------------------------------------ registry/CLI
+def test_registry_has_all_six_rules():
+    from tools.tpulint import rules as _  # noqa: F401
+    assert {"no-host-sync-in-jit", "no-tracer-branch", "explicit-dtype",
+            "collective-discipline", "no-bare-print",
+            "config-doc-sync"} <= set(RULES)
+
+
+def test_cli_json_format_and_exit_codes(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)
+        """})
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", pkg, "--format=json",
+         "--rules=explicit-dtype"],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r.returncode == 1, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["num_active"] == 1
+    assert rep["counts"] == {"explicit-dtype": 1}
+    f0 = rep["findings"][0]
+    assert f0["rule"] == "explicit-dtype" and f0["line"] == 4
+    # clean tree -> exit 0
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", pkg,
+         "--rules=no-bare-print"],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r2.returncode == 0, r2.stdout
+
+
+# ------------------------------------------------------------- suppression
+def test_suppression_same_line_and_next_line(tmp_path):
+    rep = _lint(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            a = jnp.zeros(n)  # tpulint: disable=explicit-dtype -- fixture
+            # tpulint: disable-next=explicit-dtype -- fixture
+            b = jnp.ones(n)
+            c = jnp.full(n, 0)
+            return a, b, c
+        """}, rules=["explicit-dtype"])
+    assert _rules_of(rep) == [("learner/m.py", 7, "explicit-dtype")]
+    assert len(rep.suppressed) == 2
+    assert all(f.justification == "fixture" for f in rep.suppressed)
+
+
+def test_suppression_without_justification_is_reported(tmp_path):
+    rep = _lint(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)  # tpulint: disable=explicit-dtype
+        """}, rules=["explicit-dtype"])
+    assert [f.rule for f in rep.active] == ["bad-suppression"]
+    assert len(rep.suppressed) == 1
+
+
+def test_suppression_only_masks_named_rule(tmp_path):
+    rep = _lint(tmp_path, {"m.py": """
+        def f():
+            print("hi")  # tpulint: disable=explicit-dtype -- wrong rule
+        """}, rules=["no-bare-print"])
+    assert [f.rule for f in rep.active] == ["no-bare-print"]
+
+
+# ----------------------------------------------------------- explicit-dtype
+def test_explicit_dtype_positives_and_negatives(tmp_path):
+    rep = _lint(tmp_path, {
+        "ops/dev.py": """
+        import jax.numpy as jnp
+        def f(n):
+            bad1 = jnp.zeros(n)
+            bad2 = jnp.arange(n)
+            bad3 = jnp.full((n, 2), 0.0)
+            ok1 = jnp.zeros(n, jnp.float32)     # positional dtype
+            ok2 = jnp.arange(n, dtype=jnp.int32)
+            ok3 = jnp.full((n, 2), 0.0, jnp.float32)
+            ok4 = jnp.where(ok1 > 0, 1.0, 0.0)  # not a constructor
+            return bad1, bad2, bad3, ok2, ok3, ok4
+        """,
+        # host-side module: out of scope by design
+        "host.py": """
+        import jax.numpy as jnp
+        def g(n):
+            return jnp.zeros(n)
+        """}, rules=["explicit-dtype"])
+    assert _rules_of(rep) == [("ops/dev.py", 4, "explicit-dtype"),
+                              ("ops/dev.py", 5, "explicit-dtype"),
+                              ("ops/dev.py", 6, "explicit-dtype")]
+
+
+# ----------------------------------------------------- collective-discipline
+def test_collective_discipline(tmp_path):
+    rep = _lint(tmp_path, {
+        "learner/eng.py": """
+        import jax
+        def f(x, axis):
+            return jax.lax.psum(x, axis)
+        """,
+        "parallel/dp.py": """
+        import jax
+        from jax import lax
+        def g(x, axis):
+            return lax.pmean(jax.lax.all_gather(x, axis), axis)
+        """,
+        "distributed.py": """
+        import jax
+        def h(x, axis):
+            return jax.lax.psum(x, axis)
+        """}, rules=["collective-discipline"])
+    assert _rules_of(rep) == [("learner/eng.py", 4,
+                               "collective-discipline")]
+
+
+# ------------------------------------------------------------ no-bare-print
+def test_no_bare_print(tmp_path):
+    rep = _lint(tmp_path, {
+        "boost.py": """
+        from .utils import log
+        def f():
+            print("bad")
+            log.info("ok")
+        """,
+        "utils/log.py": """
+        def info(msg):
+            print(msg)   # the whitelisted default sink
+        """}, rules=["no-bare-print"])
+    assert _rules_of(rep) == [("boost.py", 4, "no-bare-print")]
+
+
+def test_no_bare_print_clean_on_real_package():
+    rep = run_lint(PACKAGE, rules=["no-bare-print"])
+    assert rep.active == [], [f.render() for f in rep.active]
+
+
+# ------------------------------------------------------- no-host-sync-in-jit
+_JIT_PKG = {
+    "learner/mod.py": """
+    import functools
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..ops.helper import downstream
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def entry(x, y, cfg):
+        z = x * 2 + jnp.sum(y)
+        f = float(z)                  # BAD: host sync
+        a = np.asarray(x)             # BAD: host sync
+        i = z.item()                  # BAD: host sync
+        w = z.block_until_ready()     # BAD: host sync
+        n = x.shape[0]
+        ok1 = int(n)                  # ok: shape is static
+        ok2 = jnp.asarray(y)          # ok: device-side
+        ok3 = float(cfg.lr)           # ok: static param
+        downstream(z, 3)
+        return z
+
+    def host_fn(a):
+        return float(a)               # ok: not jit-reachable
+    """,
+    "ops/helper.py": """
+    def downstream(v, k):
+        bad = bool(v)                 # BAD: tainted via call graph
+        ok = int(k)                   # ok: untainted arg at call site
+        return bad, ok
+    """,
+}
+
+
+def test_no_host_sync_in_jit(tmp_path):
+    rep = _lint(tmp_path, dict(_JIT_PKG), rules=["no-host-sync-in-jit"])
+    got = _rules_of(rep)
+    assert ("learner/mod.py", 11, "no-host-sync-in-jit") in got  # float
+    assert ("learner/mod.py", 12, "no-host-sync-in-jit") in got  # asarray
+    assert ("learner/mod.py", 13, "no-host-sync-in-jit") in got  # .item
+    assert ("learner/mod.py", 14, "no-host-sync-in-jit") in got  # block
+    assert ("ops/helper.py", 3, "no-host-sync-in-jit") in got    # callee
+    # and nothing else: the ok/host_fn lines are all clean
+    assert len(got) == 5, got
+
+
+# --------------------------------------------------------- no-tracer-branch
+def test_no_tracer_branch(tmp_path):
+    rep = _lint(tmp_path, {"learner/mod.py": """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("params",))
+        def entry(x, y, params):
+            z = jnp.sum(x)
+            if z > 0:                   # BAD
+                pass
+            while y.sum() > 0:          # BAD (method call on tracer)
+                break
+            assert x[0] > 0             # BAD
+            t = 1 if z > 0 else 2       # BAD ternary
+            if params.max_depth > 0:    # ok: static param
+                pass
+            if x.shape[0] > 4:          # ok: shape
+                pass
+            if x is None:               # ok: identity test
+                pass
+            if params.forced:
+                for k, s in enumerate(params.forced):
+                    if k > 3:           # ok: python loop over statics
+                        break
+
+            def body(i, carry):
+                if carry > 0:           # BAD: fori_loop carry is traced
+                    return carry
+                return carry + i
+            return jax.lax.fori_loop(0, 3, body, z), t
+        """}, rules=["no-tracer-branch"])
+    lines = [ln for _, ln, _ in _rules_of(rep)]
+    assert lines == [9, 11, 13, 14, 27], _rules_of(rep)
+
+
+def test_jit_assignment_form_and_static_argnums(tmp_path):
+    rep = _lint(tmp_path, {"learner/mod.py": """
+        import jax
+
+        def raw(x, k):
+            if k > 0:       # ok: static_argnums=1
+                pass
+            if (x > 0).any():   # BAD
+                pass
+            return x
+
+        fn = jax.jit(raw, static_argnums=(1,))
+        """}, rules=["no-tracer-branch"])
+    assert [ln for _, ln, _ in _rules_of(rep)] == [7]
+
+
+# ---------------------------------------------------------- config-doc-sync
+_CONFIG = """
+PARAMS = [
+    ("alpha", "float", 1.0, ()),
+    ("beta", "int", 2, ("b",)),
+]
+"""
+
+
+def _doc(tmp_path, rows):
+    d = tmp_path / "docs"
+    d.mkdir(exist_ok=True)
+    body = "| Parameter | Type | Default | Aliases |\n|---|---|---|---|\n"
+    body += "\n".join(f"| `{r}` | x | `0` | — |" for r in rows) + "\n"
+    (d / "Parameters.md").write_text("# Parameters\n\n" + body)
+
+
+def test_config_doc_sync(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"config.py": _CONFIG})
+    _doc(tmp_path, ["alpha", "beta"])
+    assert run_lint(pkg, rules=["config-doc-sync"]).active == []
+    _doc(tmp_path, ["alpha", "gamma"])   # beta undocumented, gamma stale
+    rep = run_lint(pkg, rules=["config-doc-sync"])
+    msgs = sorted(f.message for f in rep.active)
+    assert len(msgs) == 2
+    assert "`beta`" in msgs[0] and "not documented" in msgs[0]
+    assert "`gamma`" in msgs[1] and "stale" in msgs[1]
+
+
+def test_config_doc_sync_missing_doc(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"config.py": _CONFIG})
+    rep = run_lint(pkg, rules=["config-doc-sync"])
+    assert [f.rule for f in rep.active] == ["config-doc-sync"]
+    assert "missing" in rep.active[0].message
+
+
+# ------------------------------------------------------------- package-wide
+def test_package_is_clean():
+    """The merge bar: zero unsuppressed findings over lightgbm_tpu with
+    ALL rules enabled (acceptance: `python -m tools.tpulint lightgbm_tpu`
+    exits 0)."""
+    rep = run_lint(PACKAGE)
+    assert rep.active == [], "\n".join(f.render() for f in rep.active)
+
+
+def test_package_suppressions_are_justified():
+    rep = run_lint(PACKAGE)
+    for f in rep.suppressed:
+        assert f.justification, f.render()
+
+
+def test_package_finds_jit_roots():
+    """Sanity: the call-graph analysis actually sees the engine's jit
+    entry points (an empty reachable set would make the two taint rules
+    vacuously green)."""
+    from tools.tpulint.callgraph import PackageIndex, build_reachable
+    from tools.tpulint.core import LintContext
+    funcs = build_reachable(PackageIndex(LintContext(PACKAGE)))
+    names = {f.qualname for f in funcs}
+    assert {"grow_tree", "grow_tree_wave", "find_best_split",
+            "build_histogram"} <= names
+    roots = {f.qualname for f in funcs if f.jit_root}
+    assert {"grow_tree", "grow_tree_wave"} <= roots
+    # static_argnames honored on the engine entry points
+    by_name = {f.qualname: f for f in funcs}
+    assert "params" in by_name["grow_tree"].static_params
+    assert "params" not in by_name["grow_tree"].tainted_params
+    assert "binned" in by_name["grow_tree"].tainted_params

@@ -1,0 +1,25 @@
+"""tpulint — JAX/TPU-aware static analysis for the lightgbm_tpu package.
+
+Rules (docs/StaticAnalysis.md):
+
+* no-host-sync-in-jit    — float()/int()/bool()/.item()/np.asarray()/
+                           .block_until_ready() on traced values in the
+                           static call graph rooted at the jax.jit entry
+                           points
+* no-tracer-branch       — Python if/while/assert on traced values
+* explicit-dtype         — jnp.zeros/ones/full/arange/array in device
+                           code must pass a dtype
+* collective-discipline  — lax.psum/pmean/all_gather only in parallel/
+                           or distributed.py
+* no-bare-print          — all output through utils.log / the event log
+* config-doc-sync        — config.py PARAMS <-> docs/Parameters.md
+
+Run:  python -m tools.tpulint [package_dir] [--format=json|text]
+Suppress:  # tpulint: disable=<rule>[,<rule>] -- <justification>
+"""
+
+from .core import (Finding, LintContext, Report, Rule, RULES,  # noqa: F401
+                   register, run_lint)
+
+__all__ = ["Finding", "LintContext", "Report", "Rule", "RULES",
+           "register", "run_lint"]

@@ -1,0 +1,57 @@
+"""Console entry: `python -m tools.tpulint [package_dir] [options]`.
+
+Exit status is the CI contract (wired into tier-1 via
+tests/test_tpulint.py; external CI calls this exactly the same way):
+
+    0  no unsuppressed findings
+    1  unsuppressed findings (or a rule/usage error)
+
+Options:
+    --format=text|json   report format (default text; json is the
+                         machine-readable report)
+    --rules=a,b          run only the named rules
+    --list-rules         print the registry and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import RULES, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="JAX/TPU-aware static analysis (docs/StaticAnalysis.md)")
+    ap.add_argument("package_dir", nargs="?", default="lightgbm_tpu",
+                    help="package tree to lint (default: lightgbm_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as _rules  # noqa: F401  (registers)
+        for name in sorted(RULES):
+            sys.stdout.write(f"{name}: {RULES[name].description}\n")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        report = run_lint(args.package_dir, rules=rules)
+    except KeyError as e:
+        sys.stderr.write(f"tpulint: {e.args[0]}\n")
+        return 1
+    if args.format == "json":
+        sys.stdout.write(report.to_json() + "\n")
+    else:
+        sys.stdout.write(report.render_text() + "\n")
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,642 @@
+"""Static jit call graph + parameter-taint analysis for tpulint.
+
+The device-code rules (no-host-sync-in-jit, no-tracer-branch) need to
+know which code runs under `jax.jit` tracing and which values are
+tracers there.  Both are approximated statically:
+
+* **Roots**: every function wrapped in jit anywhere in the package —
+  `@jax.jit`, `@functools.partial(jax.jit, static_argnames=...)`, and
+  the assignment form `f = jax.jit(g, ...)` where `g` is a local
+  function.  `static_argnames`/`static_argnums` are honored: those
+  parameters are Python values at trace time, and branching on them is
+  exactly how static configuration is supposed to work.
+
+* **Call graph**: from each root, calls to other functions defined in
+  the package (same module or via `from ..mod import name` imports) are
+  resolved and the callee is analyzed too, with its parameters tainted
+  per call site (a traced argument taints the bound parameter; a static
+  one does not).  Iterated to a fixpoint, so taint flows through helper
+  layers (grow_tree -> find_best_split -> leaf_gain).
+
+* **Taint**: within one root, a flat name->tainted environment seeded by
+  the non-static parameters.  Assignments propagate taint through
+  expressions; `.shape`/`.ndim`/`.dtype`/`.size` access yields a STATIC
+  value even on a tracer (that's how jit code legitimately branches on
+  geometry), and `is`/`is not` comparisons are host-safe identity
+  checks.  Functions passed to `lax.fori_loop`/`while_loop`/`scan`/
+  `cond`/`switch` and `jax.vmap` get their parameters tainted per the
+  lax calling contract (the loop index and carry are tracers).
+
+The approximation is deliberately parameter-rooted (matching the rule
+names): device constants built from static shapes are not tracked, and
+dynamic dispatch (methods on objects, functions stored in containers)
+is not resolved.  That keeps false positives near zero on idiomatic
+JAX; the fixture tests in tests/test_tpulint.py pin the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# attributes that are static (Python) values even on a tracer
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding"}
+# builtins whose call result is always a static Python value
+STATIC_CALLS = {"len", "isinstance", "range", "type", "getattr", "hasattr",
+                "max", "min"}
+
+_LAX_HOF = {
+    # func attr -> list of (callee_arg_index, callee_param_slice)
+    # fori_loop(lo, hi, body, init): body(i, carry) — both traced
+    "fori_loop": [(2, 2)],
+    # while_loop(cond, body, init): each takes the traced carry
+    "while_loop": [(0, 1), (1, 1)],
+    # scan(f, init, xs): f(carry, x) — both traced
+    "scan": [(0, 2)],
+    # cond(pred, true_fn, false_fn, *operands): operands traced
+    "cond": [(1, 99), (2, 99)],
+    # switch(index, branches, *operands): can't see into branch lists
+    # unless they are literal [name, ...] — handled separately
+    "switch": [],
+}
+
+
+@dataclass
+class FuncInfo:
+    """One function definition (top-level, method, or nested)."""
+    node: ast.AST                  # FunctionDef / Lambda
+    module: "ModuleInfo"
+    qualname: str
+    jit_root: bool = False
+    static_params: Set[str] = field(default_factory=set)
+    # accumulated tainted parameter names (grows monotonically)
+    tainted_params: Set[str] = field(default_factory=set)
+
+    @property
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in getattr(a, "posonlyargs", [])]
+        names += [p.arg for p in a.args]
+        names += [p.arg for p in a.kwonlyargs]
+        return names
+
+
+class ModuleInfo:
+    """Per-file index: imports and top-level functions."""
+
+    def __init__(self, pf, package_name: str):
+        self.pf = pf
+        self.package_name = package_name
+        # module dotted name, e.g. lightgbm_tpu.learner.grow
+        parts = pf.rel[:-3].split(os.sep)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.dotted = ".".join(parts)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.top_funcs: Dict[str, FuncInfo] = {}
+        if pf.tree is not None:
+            self._index(pf.tree)
+
+    def _resolve_relative(self, level: int, module: Optional[str]) -> str:
+        base = self.dotted.split(".")
+        # level=1 strips the module's own name, 2 strips one package, ...
+        base = base[:len(base) - level]
+        if module:
+            base = base + module.split(".")
+        return ".".join(base)
+
+    def _index(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.imports[al.asname or al.name.split(".")[0]] = (
+                        al.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                mod = (self._resolve_relative(node.level, node.module)
+                       if node.level else (node.module or ""))
+                for al in node.names:
+                    self.imports[al.asname or al.name] = (mod, al.name)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.top_funcs[node.name] = FuncInfo(
+                    node=node, module=self, qualname=node.name)
+
+    def dotted_of(self, expr: ast.AST) -> Optional[str]:
+        """Resolve an expression to a dotted module path, following this
+        module's imports: `np.asarray` -> numpy.asarray, `jax.lax.psum`
+        -> jax.lax.psum, `jit` imported from jax -> jax.jit."""
+        parts: List[str] = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        base = expr.id
+        if base in self.imports:
+            mod, attr = self.imports[base]
+            head = mod + ("." + attr if attr else "")
+        else:
+            head = base
+        return ".".join([head] + list(reversed(parts)))
+
+
+class PackageIndex:
+    """All modules of the linted package + jit roots."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.modules: Dict[str, ModuleInfo] = {}
+        for pf in ctx.files:
+            mi = ModuleInfo(pf, ctx.package_name)
+            self.modules[mi.dotted] = mi
+        for mi in self.modules.values():
+            self._mark_jit_roots(mi)
+
+    # ---- jit root discovery ----
+
+    def _mark_jit_roots(self, mi: ModuleInfo) -> None:
+        if mi.pf.tree is None:
+            return
+        # decorated defs (any nesting depth)
+        for node in ast.walk(mi.pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics = self._jit_decorator_statics(mi, dec, node)
+                    if statics is not None:
+                        fi = mi.top_funcs.get(node.name)
+                        if fi is None or fi.node is not node:
+                            fi = FuncInfo(node=node, module=mi,
+                                          qualname=node.name)
+                            mi.top_funcs.setdefault(
+                                f"<nested>{id(node)}", fi)
+                        fi.jit_root = True
+                        fi.static_params |= statics
+            elif isinstance(node, ast.Call):
+                # assignment/expression form: jax.jit(fn, ...)
+                if self._is_jit_name(mi, node.func) and node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Name):
+                        fi = self._find_def_anywhere(mi, target.id)
+                        if fi is not None:
+                            fi.jit_root = True
+                            fi.static_params |= self._static_names_of(
+                                mi, node, fi.node)
+                    elif isinstance(target, ast.Lambda):
+                        fi = FuncInfo(node=target, module=mi,
+                                      qualname="<lambda>")
+                        fi.jit_root = True
+                        fi.static_params |= self._static_names_of(
+                            mi, node, target)
+                        mi.top_funcs[f"<lambda>{id(target)}"] = fi
+
+    def _find_def_anywhere(self, mi: ModuleInfo, name: str
+                           ) -> Optional[FuncInfo]:
+        if name in mi.top_funcs:
+            return mi.top_funcs[name]
+        for node in ast.walk(mi.pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                fi = FuncInfo(node=node, module=mi, qualname=name)
+                mi.top_funcs[f"<nested>{id(node)}"] = fi
+                return fi
+        return None
+
+    def _is_jit_name(self, mi: ModuleInfo, expr: ast.AST) -> bool:
+        dotted = mi.dotted_of(expr)
+        return dotted in ("jax.jit", "jit")
+
+    def _jit_decorator_statics(self, mi: ModuleInfo, dec: ast.AST,
+                               fn: ast.AST) -> Optional[Set[str]]:
+        """None if `dec` is not a jit decorator; else the static param
+        names it declares."""
+        if self._is_jit_name(mi, dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            dotted = mi.dotted_of(dec.func)
+            if dotted in ("functools.partial", "partial") and dec.args \
+                    and self._is_jit_name(mi, dec.args[0]):
+                return self._static_names_of(mi, dec, fn)
+            if self._is_jit_name(mi, dec.func):
+                return self._static_names_of(mi, dec, fn)
+        return None
+
+    def _static_names_of(self, mi: ModuleInfo, call: ast.Call,
+                         fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        params = []
+        a = fn.args
+        params += [p.arg for p in getattr(a, "posonlyargs", [])]
+        params += [p.arg for p in a.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  str):
+                        out.add(v.value)
+            elif kw.arg == "static_argnums":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  int):
+                        if 0 <= v.value < len(params):
+                            out.add(params[v.value])
+        return out
+
+    # ---- cross-module function resolution ----
+
+    def resolve_call(self, mi: ModuleInfo, func: ast.AST
+                     ) -> Optional[FuncInfo]:
+        """Resolve a Call's func expression to an in-package FuncInfo
+        (same-module top-level functions or `from x import f` names)."""
+        if isinstance(func, ast.Name):
+            if func.id in mi.top_funcs:
+                return mi.top_funcs[func.id]
+            imp = mi.imports.get(func.id)
+            if imp:
+                mod, attr = imp
+                tgt = self.modules.get(mod)
+                if tgt and attr and attr in tgt.top_funcs:
+                    return tgt.top_funcs[attr]
+        elif isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                            ast.Name):
+            imp = mi.imports.get(func.value.id)
+            if imp and imp[1] is None:
+                tgt = self.modules.get(imp[0])
+                if tgt and func.attr in tgt.top_funcs:
+                    return tgt.top_funcs[func.attr]
+        return None
+
+
+def walk_scope(root: ast.AST):
+    """Yield `root` and every descendant that belongs to root's lexical
+    scope — nested FunctionDef/Lambda nodes are yielded (they are bound
+    in this scope) but their interiors are not."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                yield child
+                # decorators/defaults evaluate in the enclosing scope
+                for d in getattr(child, "decorator_list", []):
+                    stack.append(d)
+                for d in child.args.defaults + [
+                        x for x in child.args.kw_defaults if x]:
+                    stack.append(d)
+            else:
+                stack.append(child)
+
+
+class Scope:
+    """One lexical scope (function body) with Python shadowing rules: a
+    name assigned anywhere in the scope is local throughout it."""
+
+    def __init__(self, node: ast.AST, parent: Optional["Scope"]):
+        self.node = node
+        self.parent = parent
+        self.assigned: Set[str] = set()
+        self.tainted: Set[str] = set()
+        a = node.args
+        for p in (list(getattr(a, "posonlyargs", [])) + list(a.args)
+                  + list(a.kwonlyargs)):
+            self.assigned.add(p.arg)
+        if a.vararg:
+            self.assigned.add(a.vararg.arg)
+        if a.kwarg:
+            self.assigned.add(a.kwarg.arg)
+        if not isinstance(node, ast.Lambda):
+            self._collect_assigned()
+
+    def _collect_assigned(self) -> None:
+        for n in walk_scope(self.node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.assigned.add(n.name)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    self._bind(t)
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                self._bind(n.target)
+            elif isinstance(n, ast.NamedExpr):
+                self._bind(n.target)
+            elif isinstance(n, ast.For):
+                self._bind(n.target)
+            elif isinstance(n, ast.withitem):
+                if n.optional_vars is not None:
+                    self._bind(n.optional_vars)
+            elif isinstance(n, ast.comprehension):
+                self._bind(n.target)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                self.assigned.add(n.name)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for al in n.names:
+                    self.assigned.add(
+                        (al.asname or al.name).split(".")[0])
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                for name in n.names:
+                    self.assigned.discard(name)
+
+    def _bind(self, target: ast.AST) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self.assigned.add(n.id)
+
+    def owner_of(self, name: str) -> Optional["Scope"]:
+        s = self
+        while s is not None:
+            if name in s.assigned:
+                return s
+            s = s.parent
+        return None
+
+    def is_tainted(self, name: str) -> bool:
+        s = self.owner_of(name)
+        return s is not None and name in s.tainted
+
+    def add_taint(self, name: str) -> bool:
+        s = self.owner_of(name) or self
+        if name in s.tainted:
+            return False
+        s.tainted.add(name)
+        return True
+
+
+class TaintWalker:
+    """Lexically-scoped taint propagation over one jit-rooted function
+    (including its nested defs).  Violations are collected by the rules
+    via `taint(expr)`; callee taints are reported back for the
+    cross-module fixpoint."""
+
+    def __init__(self, index: PackageIndex, fi: FuncInfo):
+        self.index = index
+        self.mi = fi.module
+        self.fi = fi
+        # scope tree + node -> owning scope map
+        self.scopes: List[Scope] = []
+        self.scope_of_def: Dict[int, Scope] = {}
+        self.node_scope: Dict[int, Scope] = {}
+        self._build_scopes(fi.node, None)
+        root = self.scope_of_def[id(fi.node)]
+        for name in fi.tainted_params:
+            root.tainted.add(name)
+        # nested function name -> def node (first definition wins)
+        self.nested: Dict[str, ast.AST] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fi.node:
+                name = getattr(node, "name", None)
+                if name and name not in self.nested:
+                    self.nested[name] = node
+        # taints discovered for in-package callees: FuncInfo -> set(param)
+        self.callee_taints: Dict[int, Tuple[FuncInfo, Set[str]]] = {}
+
+    def _build_scopes(self, node: ast.AST, parent: Optional[Scope]) -> None:
+        scope = Scope(node, parent)
+        self.scopes.append(scope)
+        self.scope_of_def[id(node)] = scope
+        for n in walk_scope(node):
+            self.node_scope.setdefault(id(n), scope)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not node:
+                self._build_scopes(n, scope)
+
+    # ---- expression taint ----
+
+    def taint(self, e: Optional[ast.AST], scope: Optional[Scope] = None
+              ) -> bool:
+        """Is `e` (a node anywhere in this root's tree) possibly a
+        tracer?  Scope is looked up from the node when not given."""
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if scope is None:
+            scope = self.node_scope.get(id(e))
+            if scope is None:
+                return False
+        return self._taint(e, scope)
+
+    def _taint(self, e: Optional[ast.AST], scope: Scope) -> bool:
+        taint = lambda x: self._taint(x, scope)  # noqa: E731
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Name):
+            return scope.is_tainted(e.id)
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return False
+            return taint(e.value)
+        if isinstance(e, ast.Subscript):
+            return taint(e.value) or taint(e.slice)
+        if isinstance(e, ast.Call):
+            dotted = self.mi.dotted_of(e.func)
+            if dotted in STATIC_CALLS:
+                return False
+            args = list(e.args) + [kw.value for kw in e.keywords]
+            if any(taint(a) for a in args):
+                return True
+            # a method call on a tracer returns a tracer (x.sum(),
+            # x.astype(...)); module functions (jnp.sum) are covered by
+            # their arguments above
+            return isinstance(e.func, ast.Attribute) and taint(e.func)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False
+            return taint(e.left) or any(taint(c)
+                                             for c in e.comparators)
+        if isinstance(e, (ast.BinOp,)):
+            return taint(e.left) or taint(e.right)
+        if isinstance(e, ast.BoolOp):
+            return any(taint(v) for v in e.values)
+        if isinstance(e, ast.UnaryOp):
+            return taint(e.operand)
+        if isinstance(e, ast.IfExp):
+            return (taint(e.test) or taint(e.body)
+                    or taint(e.orelse))
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(taint(x) for x in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(taint(x) for x in e.keys if x is not None) \
+                or any(taint(x) for x in e.values)
+        if isinstance(e, ast.Starred):
+            return taint(e.value)
+        if isinstance(e, ast.NamedExpr):
+            return taint(e.value)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return (taint(e.elt)
+                    or any(taint(g.iter) for g in e.generators))
+        if isinstance(e, ast.DictComp):
+            return (taint(e.key) or taint(e.value)
+                    or any(taint(g.iter) for g in e.generators))
+        if isinstance(e, ast.Slice):
+            return any(taint(x) for x in (e.lower, e.upper, e.step))
+        return False
+
+    # ---- environment fixpoint ----
+
+    def _changed(self) -> int:
+        return sum(len(s.tainted) for s in self.scopes)
+
+    def _bind_names(self, target: ast.AST, scope: Scope) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                scope.add_taint(node.id)
+
+    def _taint_callee_params(self, node: ast.AST, first_k: int) -> None:
+        """Mark the first `first_k` parameters of a locally-nested or
+        in-package function as tainted (lax/vmap calling contracts)."""
+        name = node.id if isinstance(node, ast.Name) else None
+        fn = self.nested.get(name) if name else None
+        if fn is not None:
+            child = self.scope_of_def.get(id(fn))
+            if child is not None:
+                for p in fn.args.args[:first_k]:
+                    child.tainted.add(p.arg)
+            return
+        if name:
+            fi = self.index.resolve_call(self.mi, node)
+            if fi is not None:
+                names = fi.param_names[:first_k]
+                self._record_callee(fi, set(names) - fi.static_params)
+
+    def _record_callee(self, fi: FuncInfo, tainted: Set[str]) -> None:
+        tainted = tainted - fi.static_params
+        key = id(fi)
+        if key in self.callee_taints:
+            self.callee_taints[key][1].update(tainted)
+        else:
+            # an empty edge still puts the callee in the reachable set
+            self.callee_taints[key] = (fi, set(tainted))
+
+    def _taint_def_params(self, fn: ast.AST, e: ast.Call,
+                          scope: Scope) -> None:
+        """Bind a direct call's tainted args onto a nested def's params
+        (in its own scope)."""
+        child = self.scope_of_def.get(id(fn))
+        if child is None:
+            return
+        params = [p.arg for p in fn.args.args]
+        for i, a in enumerate(e.args):
+            if isinstance(a, ast.Starred):
+                continue
+            if i < len(params) and self._taint(a, scope):
+                child.tainted.add(params[i])
+        for kw in e.keywords:
+            if kw.arg and kw.arg in params and self._taint(kw.value, scope):
+                child.tainted.add(kw.arg)
+
+    def _propagate_call(self, e: ast.Call, scope: Scope) -> None:
+        """Taint flow into nested functions / package callees."""
+        dotted = self.mi.dotted_of(e.func) or ""
+        tail = dotted.rsplit(".", 1)[-1]
+        # lax higher-order functions taking a function argument
+        if dotted.startswith(("jax.lax.", "lax.")) and tail in _LAX_HOF:
+            for arg_i, k in _LAX_HOF[tail]:
+                if arg_i < len(e.args):
+                    self._taint_callee_params(e.args[arg_i], k)
+            if tail == "switch" and len(e.args) >= 2 \
+                    and isinstance(e.args[1], (ast.List, ast.Tuple)):
+                for elt in e.args[1].elts:
+                    self._taint_callee_params(elt, 99)
+            return
+        # jax.vmap(f)(...) etc: the func is itself a call whose first
+        # arg names a function; its operands are traced
+        if isinstance(e.func, ast.Call):
+            inner = self.mi.dotted_of(e.func.func) or ""
+            if inner.rsplit(".", 1)[-1] in ("vmap", "pmap", "checkpoint",
+                                            "remat", "shard_map"):
+                if e.func.args:
+                    self._taint_callee_params(e.func.args[0], 99)
+            return
+        # direct call to a nested def: bind args -> params
+        if isinstance(e.func, ast.Name) and e.func.id in self.nested:
+            self._taint_def_params(self.nested[e.func.id], e, scope)
+            return
+        # direct call to an in-package function
+        fi = self.index.resolve_call(self.mi, e.func)
+        if fi is not None and fi.node is not self.fi.node:
+            params = fi.param_names
+            tainted: Set[str] = set()
+            for i, a in enumerate(e.args):
+                if isinstance(a, ast.Starred):
+                    continue
+                if i < len(params) and self._taint(a, scope):
+                    tainted.add(params[i])
+            for kw in e.keywords:
+                if kw.arg and self._taint(kw.value, scope):
+                    tainted.add(kw.arg)
+            self._record_callee(fi, tainted)
+
+    def run_env_fixpoint(self, max_iter: int = 16) -> None:
+        for _ in range(max_iter):
+            before = self._changed()
+            for scope in self.scopes:
+                for node in walk_scope(scope.node):
+                    if isinstance(node, ast.Assign):
+                        if self._taint(node.value, scope):
+                            for t in node.targets:
+                                self._bind_names(t, scope)
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        if node.value is not None \
+                                and self._taint(node.value, scope):
+                            self._bind_names(node.target, scope)
+                    elif isinstance(node, ast.NamedExpr):
+                        if self._taint(node.value, scope):
+                            self._bind_names(node.target, scope)
+                    elif isinstance(node, ast.For):
+                        if self._taint(node.iter, scope):
+                            self._bind_names(node.target, scope)
+                    elif isinstance(node, ast.withitem):
+                        if node.optional_vars is not None \
+                                and self._taint(node.context_expr, scope):
+                            self._bind_names(node.optional_vars, scope)
+                    elif isinstance(node, ast.Return):
+                        # `return tracer` marks the function name itself
+                        # nothing: call-result taint is approximated by
+                        # argument taint in _taint (Call case)
+                        pass
+                    elif isinstance(node, ast.Call):
+                        self._propagate_call(node, scope)
+            if self._changed() == before:
+                break
+
+def build_reachable(index: PackageIndex) -> List[FuncInfo]:
+    """Fixpoint over the call graph: analyze every jit root, propagate
+    parameter taints into in-package callees, repeat until stable.
+    Returns the analyzed FuncInfos (roots + jit-reachable callees) with
+    `tainted_params` filled in; walkers are cached on each FuncInfo as
+    `_walker` for the rules to consume."""
+    work: List[FuncInfo] = []
+    for mi in index.modules.values():
+        for fi in mi.top_funcs.values():
+            if fi.jit_root:
+                a = fi.node.args
+                names = [p.arg for p in getattr(a, "posonlyargs", [])]
+                names += [p.arg for p in a.args]
+                names += [p.arg for p in a.kwonlyargs]
+                fi.tainted_params = set(names) - fi.static_params
+                work.append(fi)
+    analyzed: Dict[int, FuncInfo] = {}
+    for _ in range(20):  # cross-function fixpoint
+        changed = False
+        queue = list(work) + [fi for fi in analyzed.values()
+                              if not fi.jit_root]
+        seen: Set[int] = set()
+        for fi in queue:
+            if id(fi) in seen or fi.node is None:
+                continue
+            seen.add(id(fi))
+            walker = TaintWalker(index, fi)
+            walker.run_env_fixpoint()
+            fi._walker = walker  # type: ignore[attr-defined]
+            analyzed[id(fi)] = fi
+            for _, (callee, taints) in walker.callee_taints.items():
+                new = taints - callee.tainted_params
+                if new or id(callee) not in analyzed:
+                    callee.tainted_params |= new
+                    if id(callee) not in analyzed:
+                        analyzed[id(callee)] = callee
+                    changed = True
+        if not changed:
+            break
+    return list(analyzed.values())

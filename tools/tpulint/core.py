@@ -1,0 +1,244 @@
+"""tpulint core: findings, rule registry, suppressions, runner.
+
+The suite is the compile-time guard for the invariants that determine
+TPU performance (docs/StaticAnalysis.md): a stray host sync or a
+weak-typed literal inside the jitted tree program costs a device round
+trip or a recompile per iteration — regressions PR 2's recompile
+watchdog can only catch at runtime, after the fact.  tpulint moves the
+enforcement to lint time, the way the reference enforces its logging
+and CHECK_* discipline at compile time (ref: include/LightGBM/utils/
+log.h).
+
+Design: every rule is a registered object with a `check(ctx)` returning
+`Finding`s; the runner parses the package once into a `LintContext`
+(ASTs + per-line suppressions) shared by all rules.  Suppressions are
+per-line:
+
+    x = float(s)  # tpulint: disable=no-host-sync-in-jit -- why it's ok
+    # tpulint: disable-next=explicit-dtype -- why it's ok
+    y = jnp.zeros(n)
+
+A justification (the text after `--`) is REQUIRED: a disable comment
+without one is itself reported (rule `bad-suppression`), so the merge
+bar "every suppression carries a justification" is enforced
+mechanically, not by review.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable(?P<next>-next)?\s*=\s*"
+    r"(?P<rules>[\w,\-]+)"
+    r"(?:\s*--\s*(?P<why>.*\S))?")
+
+
+@dataclass
+class Finding:
+    """One lint finding; `suppressed` is filled in by the runner."""
+    rule: str
+    path: str          # relative to the linted package's parent
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "suppressed": self.suppressed,
+                "justification": self.justification}
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}{tag}"
+
+
+@dataclass
+class Suppression:
+    rules: Set[str]
+    justification: str
+    line: int           # line the suppression APPLIES to
+    comment_line: int   # line the comment sits on
+    used: bool = False
+
+
+@dataclass
+class PyFile:
+    """One parsed source file of the linted tree."""
+    abspath: str
+    rel: str            # relative to the package parent (e.g. lightgbm_tpu/engine.py)
+    pkg_rel: str        # relative to the package dir (e.g. engine.py)
+    source: str
+    tree: Optional[ast.AST]
+    parse_error: Optional[SyntaxError]
+    # line -> suppressions applying to that line
+    suppressions: Dict[int, List[Suppression]] = field(default_factory=dict)
+
+
+def _parse_suppressions(source: str) -> List[Suppression]:
+    out: List[Suppression] = []
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        target = i + 1 if m.group("next") else i
+        out.append(Suppression(rules=rules,
+                               justification=(m.group("why") or "").strip(),
+                               line=target, comment_line=i))
+    return out
+
+
+class LintContext:
+    """Parsed view of one package tree, shared by all rules."""
+
+    def __init__(self, package_dir: str, docs_dir: Optional[str] = None):
+        self.package_dir = os.path.abspath(package_dir)
+        self.root = os.path.dirname(self.package_dir)
+        self.package_name = os.path.basename(self.package_dir)
+        self.docs_dir = docs_dir or os.path.join(self.root, "docs")
+        self.files: List[PyFile] = []
+        self._load()
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.package_dir):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                abspath = os.path.join(dirpath, fname)
+                with open(abspath, encoding="utf-8") as f:
+                    source = f.read()
+                tree, err = None, None
+                try:
+                    tree = ast.parse(source, filename=abspath)
+                except SyntaxError as e:
+                    err = e
+                pf = PyFile(
+                    abspath=abspath,
+                    rel=os.path.relpath(abspath, self.root),
+                    pkg_rel=os.path.relpath(abspath, self.package_dir),
+                    source=source, tree=tree, parse_error=err)
+                for sup in _parse_suppressions(source):
+                    pf.suppressions.setdefault(sup.line, []).append(sup)
+                self.files.append(pf)
+
+    def file_by_pkg_rel(self, pkg_rel: str) -> Optional[PyFile]:
+        for pf in self.files:
+            if pf.pkg_rel == pkg_rel:
+                return pf
+        return None
+
+
+class Rule:
+    """Base class: subclasses set `name`/`description` and implement
+    check().  Adding a rule = subclass + @register (docs/StaticAnalysis.md
+    "Adding a rule")."""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    inst = cls()
+    assert inst.name and inst.name not in RULES, f"bad rule: {cls}"
+    RULES[inst.name] = inst
+    return cls
+
+
+@dataclass
+class Report:
+    findings: List[Finding]
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_dict(self) -> Dict:
+        counts: Dict[str, int] = {}
+        for f in self.active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {"findings": [f.to_dict() for f in self.findings],
+                "counts": counts,
+                "num_active": len(self.active),
+                "num_suppressed": len(self.suppressed)}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(f"{len(self.active)} finding(s), "
+                     f"{len(self.suppressed)} suppressed")
+        return "\n".join(lines)
+
+
+def _apply_suppressions(ctx: LintContext, findings: List[Finding]
+                        ) -> List[Finding]:
+    by_rel = {pf.rel: pf for pf in ctx.files}
+    for f in findings:
+        pf = by_rel.get(f.path)
+        if pf is None:
+            continue
+        for sup in pf.suppressions.get(f.line, []):
+            if f.rule in sup.rules:
+                f.suppressed = True
+                f.justification = sup.justification
+                sup.used = True
+    # a suppression without a justification defeats the audit trail:
+    # report it as a finding of its own (never suppressible)
+    for pf in ctx.files:
+        for sups in pf.suppressions.values():
+            for sup in sups:
+                if not sup.justification:
+                    findings.append(Finding(
+                        rule="bad-suppression", path=pf.rel,
+                        line=sup.comment_line, col=0,
+                        message="tpulint disable comment without a "
+                                "justification (append ' -- <reason>')"))
+    return findings
+
+
+def run_lint(package_dir: str, rules: Optional[List[str]] = None,
+             docs_dir: Optional[str] = None) -> Report:
+    """Run the (selected) rules over one package tree."""
+    # rule modules self-register on import
+    from . import rules as _rules  # noqa: F401
+    ctx = LintContext(package_dir, docs_dir=docs_dir)
+    selected = list(RULES) if rules is None else list(rules)
+    findings: List[Finding] = []
+    for pf in ctx.files:
+        if pf.parse_error is not None:
+            findings.append(Finding(
+                rule="syntax-error", path=pf.rel,
+                line=pf.parse_error.lineno or 0, col=0,
+                message=f"cannot parse: {pf.parse_error.msg}"))
+    for name in selected:
+        rule = RULES.get(name)
+        if rule is None:
+            raise KeyError(f"unknown tpulint rule: {name} "
+                           f"(known: {', '.join(sorted(RULES))})")
+        findings.extend(rule.check(ctx))
+    findings = _apply_suppressions(ctx, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings=findings)

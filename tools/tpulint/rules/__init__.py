@@ -1,0 +1,13 @@
+"""tpulint rule modules — importing this package registers every rule.
+
+Each module defines one or two `core.Rule` subclasses decorated with
+`@core.register`; `core.run_lint` imports this package so the registry
+is always complete.  To add a rule, drop a module here and import it
+below (docs/StaticAnalysis.md "Adding a rule").
+"""
+
+from . import bare_print      # noqa: F401
+from . import collectives     # noqa: F401
+from . import config_doc      # noqa: F401
+from . import dtype           # noqa: F401
+from . import host_sync       # noqa: F401

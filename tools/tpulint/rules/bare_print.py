@@ -1,0 +1,49 @@
+"""no-bare-print: all runtime output goes through utils.log or the
+structured event log (observability/events.py), never bare print().
+
+Ported from tools/check_no_bare_print.py (ISSUE 2 satellite; now an
+ISSUE 3 rule), same rationale and whitelist: a bare print() bypasses
+verbosity gating, the register_logger redirection the sklearn wrapper
+relies on, and the rank-tagged event log — under multi-process SPMD it
+also interleaves unsynchronized worker output.  The reference enforces
+the same discipline with its Log:: macros (include/LightGBM/utils/
+log.h).
+
+Whitelist: utils/log.py, where print() IS the default stderr sink.
+`sys.stderr.write` is not flagged (used by the crash-injection marker
+in reliability/faults.py, which must bypass any registered logger
+right before os._exit).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..core import Finding, LintContext, Rule, register
+
+WHITELIST = {os.path.join("utils", "log.py")}
+
+
+@register
+class NoBarePrint(Rule):
+    name = "no-bare-print"
+    description = ("bare print() in the runtime package; route output "
+                   "through utils.log or the event log")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for pf in ctx.files:
+            if pf.tree is None or pf.pkg_rel in WHITELIST:
+                continue
+            for node in ast.walk(pf.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    out.append(Finding(
+                        rule=self.name, path=pf.rel, line=node.lineno,
+                        col=node.col_offset,
+                        message="bare print() — route output through "
+                                "utils.log or the event log"))
+        return out

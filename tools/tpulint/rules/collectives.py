@@ -1,0 +1,59 @@
+"""collective-discipline: cross-device collectives only in parallel/ or
+distributed.py.
+
+Under SPMD every rank must issue the SAME collectives in the SAME order
+or the mesh deadlocks (the reference centralizes this in Network::
+Allreduce / ReduceScatter, src/network/network.cpp, for the same
+reason).  Keeping `lax.psum`/`pmean`/`all_gather`/... inside the
+parallel layer keeps collective ordering auditable in one place — a
+psum buried in a learner helper is invisible to whoever reorders the
+training loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..core import Finding, LintContext, Rule, register
+
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+               "psum_scatter", "all_to_all", "ppermute"}
+ALLOWED_DIRS = ("parallel",)
+ALLOWED_FILES = {"distributed.py"}
+
+
+def _is_allowed(pkg_rel: str) -> bool:
+    parts = pkg_rel.split(os.sep)
+    return parts[0] in ALLOWED_DIRS or pkg_rel in ALLOWED_FILES
+
+
+@register
+class CollectiveDiscipline(Rule):
+    name = "collective-discipline"
+    description = ("lax collective outside parallel/ or distributed.py; "
+                   "SPMD collective ordering must stay auditable")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        from ..callgraph import ModuleInfo
+        out: List[Finding] = []
+        for pf in ctx.files:
+            if pf.tree is None or _is_allowed(pf.pkg_rel):
+                continue
+            mi = ModuleInfo(pf, ctx.package_name)
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = mi.dotted_of(node.func) or ""
+                parts = dotted.rsplit(".", 1)
+                if len(parts) == 2 and parts[1] in COLLECTIVES \
+                        and parts[0] in ("jax.lax", "lax"):
+                    out.append(Finding(
+                        rule=self.name, path=pf.rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"lax.{parts[1]} outside parallel/ or "
+                                "distributed.py — collectives live in the "
+                                "parallel layer so SPMD ordering stays "
+                                "auditable"))
+        return out

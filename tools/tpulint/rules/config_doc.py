@@ -1,0 +1,93 @@
+"""config-doc-sync: config.py PARAMS and docs/Parameters.md must match.
+
+The parameter table is the single source of truth (ref: the reference's
+.ci/parameter-generator.py renders docs/Parameters.rst from config.h
+doc-comments for the same reason).  tools/gen_params_doc.py REGENERATES
+the doc; this rule VERIFIES the two never drift — a new Config field
+without a doc row (or a stale doc row after a rename) fails lint, so
+drift can't merge even when someone edits one side by hand.
+
+Both sides are read statically: PARAMS via AST (no package import — the
+lint must not need jax), the doc via the generated table's `| `name` |`
+row shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List
+
+from ..core import Finding, LintContext, Rule, register
+
+_DOC_ROW_RE = re.compile(r"^\|\s*`(\w+)`\s*\|")
+
+
+def params_from_config(pf) -> Dict[str, int]:
+    """name -> lineno of every PARAMS entry in a parsed config.py."""
+    out: Dict[str, int] = {}
+    if pf is None or pf.tree is None:
+        return out
+    for node in pf.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "PARAMS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.List, ast.Tuple)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and elt.elts \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    out[elt.elts[0].value] = elt.lineno
+    return out
+
+
+def params_from_doc(doc_path: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    with open(doc_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            m = _DOC_ROW_RE.match(line.strip())
+            if m and m.group(1) != "Parameter":
+                out[m.group(1)] = i
+    return out
+
+
+@register
+class ConfigDocSync(Rule):
+    name = "config-doc-sync"
+    description = ("config.py PARAMS and docs/Parameters.md out of sync "
+                   "(run tools/gen_params_doc.py)")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        pf = ctx.file_by_pkg_rel("config.py")
+        if pf is None:
+            return out  # package without a config module: nothing to sync
+        params = params_from_config(pf)
+        if not params:
+            return out
+        doc_path = os.path.join(ctx.docs_dir, "Parameters.md")
+        doc_rel = os.path.relpath(doc_path, ctx.root)
+        if not os.path.exists(doc_path):
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=1, col=0,
+                message=f"{doc_rel} is missing — run "
+                        "tools/gen_params_doc.py"))
+            return out
+        doc = params_from_doc(doc_path)
+        for name, lineno in params.items():
+            if name not in doc:
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=lineno, col=0,
+                    message=f"Config field `{name}` is not documented in "
+                            f"{doc_rel} — run tools/gen_params_doc.py"))
+        for name, lineno in doc.items():
+            if name not in params:
+                out.append(Finding(
+                    rule=self.name, path=doc_rel, line=lineno, col=0,
+                    message=f"documented parameter `{name}` does not "
+                            "exist in config.py PARAMS — stale doc row, "
+                            "run tools/gen_params_doc.py"))
+        return out

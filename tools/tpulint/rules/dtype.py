@@ -1,0 +1,73 @@
+"""explicit-dtype: array constructors in device code must pass a dtype.
+
+A dtype-less `jnp.zeros(n)` is float32 but WEAK-typed: mixed into an
+expression it can silently promote the whole computation (or flip the
+result's weak-type flag, which changes the jit cache key and triggers a
+recompile — exactly what PR 2's RecompileDetector fires on at runtime).
+`jnp.arange(n)` similarly weak-types to int32/float32 by value.  In the
+hot tree-growth path every such literal is a latent recompile or an
+accidental f64/i64 promotion under `jax_enable_x64`, so device code
+spells dtypes out.
+
+Scope: learner/, ops/, parallel/, io/device_bin.py — the modules whose
+arrays feed jitted programs.  Host-side code (metrics, plotting, IO
+parsing) may rely on NumPy-style defaults.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..core import Finding, LintContext, Rule, register
+
+# constructor -> number of positional args that includes a positional
+# dtype (e.g. jnp.zeros(shape, dtype) -> 2)
+CONSTRUCTORS = {"zeros": 2, "ones": 2, "full": 3, "arange": 4,
+                "array": 2, "empty": 2, "eye": 3}
+SCOPE_DIRS = ("learner", "ops", "parallel")
+SCOPE_FILES = {os.path.join("io", "device_bin.py")}
+
+
+def _in_scope(pkg_rel: str) -> bool:
+    parts = pkg_rel.split(os.sep)
+    return parts[0] in SCOPE_DIRS or pkg_rel in SCOPE_FILES
+
+
+@register
+class ExplicitDtype(Rule):
+    name = "explicit-dtype"
+    description = ("jnp array constructor without an explicit dtype in "
+                   "device code (weak-type promotion / recompile hazard)")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        from ..callgraph import ModuleInfo
+        out: List[Finding] = []
+        for pf in ctx.files:
+            if pf.tree is None or not _in_scope(pf.pkg_rel):
+                continue
+            mi = ModuleInfo(pf, ctx.package_name)
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = mi.dotted_of(node.func) or ""
+                parts = dotted.rsplit(".", 1)
+                if len(parts) != 2 or parts[0] not in ("jax.numpy", "jnp"):
+                    continue
+                fn = parts[1]
+                if fn not in CONSTRUCTORS:
+                    continue
+                if any(kw.arg == "dtype" for kw in node.keywords):
+                    continue
+                n_pos = len([a for a in node.args
+                             if not isinstance(a, ast.Starred)])
+                if n_pos >= CONSTRUCTORS[fn] and n_pos == len(node.args):
+                    continue  # positional dtype present
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"jnp.{fn} without an explicit dtype — "
+                            "weak-typed literals promote silently and "
+                            "can flip the jit cache key"))
+        return out

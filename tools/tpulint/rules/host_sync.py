@@ -1,0 +1,144 @@
+"""no-host-sync-in-jit and no-tracer-branch: the jit purity rules.
+
+Both rules consume the static jit call graph + parameter taint built by
+callgraph.py: every function wrapped in `jax.jit` (or reachable from
+one through in-package calls with traced arguments) is device code, and
+values derived from its non-static parameters are tracers.
+
+* **no-host-sync-in-jit** flags concretizations of a tracer —
+  `float(x)`, `int(x)`, `bool(x)`, `.item()`, `.tolist()`,
+  `np.asarray(x)` / `np.array(x)`, `.block_until_ready()`.  Inside jit
+  these either raise TracerConversionError at trace time or, worse,
+  silently constant-fold a value that should be traced; on the hot path
+  each one is a device round trip (SURVEY.md §3.3: the CUDA learner's
+  per-split D2H sync is the thing the TPU port exists to avoid).
+
+* **no-tracer-branch** flags Python control flow on a tracer — `if`/
+  `while`/`assert`/ternary on a traced value.  Data-dependent control
+  flow must use `lax.cond`/`lax.while_loop`/`jnp.where`; a Python
+  branch either fails to trace or silently specializes the program to
+  one side.  Branching on static parameters (`static_argnames`), on
+  `.shape`/`.dtype`/`.ndim`, and `is`/`is not None` checks are all
+  fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..callgraph import PackageIndex, build_reachable
+from ..core import Finding, LintContext, Rule, register
+
+SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+NUMPY_MODULES = ("numpy", "np")
+NUMPY_FUNCS = {"asarray", "array"}
+
+
+def _analyze(ctx: LintContext):
+    """Build (and cache on ctx) the analyzed jit-reachable functions."""
+    cached = getattr(ctx, "_tpulint_reachable", None)
+    if cached is None:
+        index = PackageIndex(ctx)
+        cached = (index, build_reachable(index))
+        ctx._tpulint_reachable = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _for_each_function(ctx, visit):
+    _, funcs = _analyze(ctx)
+    seen_nodes = set()
+    for fi in funcs:
+        if id(fi.node) in seen_nodes:
+            continue
+        seen_nodes.add(id(fi.node))
+        walker = getattr(fi, "_walker", None)
+        if walker is None:
+            continue
+        visit(fi, walker)
+
+
+@register
+class NoHostSyncInJit(Rule):
+    name = "no-host-sync-in-jit"
+    description = ("host synchronization (float/int/bool/.item/"
+                   "np.asarray/.block_until_ready) on a traced value "
+                   "inside jit-reachable code")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+
+        def visit(fi, walker):
+            pf = fi.module.pf
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in SYNC_BUILTINS \
+                        and node.args and walker.taint(node.args[0]):
+                    msg = (f"{node.func.id}() concretizes a traced value "
+                           "inside jit — keep it on device (jnp ops / "
+                           "astype) or hoist it out of the jitted region")
+                elif isinstance(node.func, ast.Attribute):
+                    if node.func.attr in SYNC_METHODS \
+                            and walker.taint(node.func.value):
+                        msg = (f".{node.func.attr}() on a traced value "
+                               "inside jit — a host sync / trace error")
+                    else:
+                        dotted = fi.module.dotted_of(node.func) or ""
+                        parts = dotted.rsplit(".", 1)
+                        if len(parts) == 2 \
+                                and parts[0] in NUMPY_MODULES \
+                                and parts[1] in NUMPY_FUNCS \
+                                and node.args \
+                                and walker.taint(node.args[0]):
+                            msg = (f"np.{parts[1]}() on a traced value "
+                                   "inside jit pulls it to the host — "
+                                   "use jnp.asarray or keep the value "
+                                   "traced")
+                if msg is not None:
+                    out.append(Finding(
+                        rule=self.name, path=pf.rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=msg + f" (in jit-reachable "
+                                      f"`{fi.qualname}`)"))
+        _for_each_function(ctx, visit)
+        return out
+
+
+@register
+class NoTracerBranch(Rule):
+    name = "no-tracer-branch"
+    description = ("Python if/while/assert on a traced value inside "
+                   "jit-reachable code; use lax.cond/lax.while_loop/"
+                   "jnp.where")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+
+        def visit(fi, walker):
+            pf = fi.module.pf
+            for node in ast.walk(fi.node):
+                kind = None
+                test = None
+                if isinstance(node, ast.If):
+                    kind, test = "if", node.test
+                elif isinstance(node, ast.While):
+                    kind, test = "while", node.test
+                elif isinstance(node, ast.Assert):
+                    kind, test = "assert", node.test
+                elif isinstance(node, ast.IfExp):
+                    kind, test = "ternary", node.test
+                if kind is None or not walker.taint(test):
+                    continue
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"Python {kind} on a traced value in "
+                            f"jit-reachable `{fi.qualname}` — use "
+                            "lax.cond/lax.while_loop/jnp.where (or mark "
+                            "the argument static)"))
+        _for_each_function(ctx, visit)
+        return out
