@@ -816,7 +816,8 @@ class Booster:
             # directly without the training-time valid machinery
             core = data._core_or_construct()
             X = g._raw_or_reconstruct(core)
-            raw = g.predict_raw(np.asarray(X, np.float64))
+            # no float64 cast: float32 data takes the device traversal
+            raw = g.predict_raw(X)
             score = raw.T if raw.ndim == 2 else raw[None, :]
             metrics = create_metrics(g.config)
             for m in metrics:
@@ -829,7 +830,9 @@ class Booster:
             # current model's raw predictions into the score buffer
             core = data._core_or_construct()
             X = g._raw_or_reconstruct(core)
-            raw = g.predict_raw(np.asarray(X, np.float64))
+            # fresh-data eval seeding: float32 raw data rides the device
+            # traversal; the float64 score buffer keeps host precision
+            raw = g.predict_raw(X)
             g.valid_scores[-1] += (raw.T if raw.ndim == 2
                                    else raw[None, :])
         return [e for e in self.eval_valid(feval) if e[0] == name]
@@ -937,8 +940,10 @@ class Booster:
         pred_kwargs = {k: v for k, v in kwargs.items()
                        if k in ("pred_early_stop", "pred_early_stop_freq",
                                 "pred_early_stop_margin")}
-        return self._gbdt.predict(np.asarray(data, np.float64),
-                                  raw_score=raw_score,
+        # _coerce_matrix preserved float32: the device inference path
+        # (docs/Inference.md) only engages on float32 inputs, where its
+        # routing is bit-identical; GBDT casts to float64 for host paths
+        return self._gbdt.predict(data, raw_score=raw_score,
                                   start_iteration=start_iteration,
                                   num_iteration=num_iteration,
                                   pred_leaf=pred_leaf, **pred_kwargs)

@@ -1700,6 +1700,79 @@ class GBDT:
                                     self.average_output_, convert=conv)
         return sp if sp.ok else None
 
+    def _device_predictor(self, X, start_iteration: int, num_iteration: int,
+                          pred_early_stop: bool = False):
+        """Route decision for the TPU-resident inference path
+        (docs/Inference.md fallback matrix).  Returns a ready
+        DevicePredictor, or None when the host paths must serve:
+        float64 data (the bit-exact routing argument needs float32
+        inputs), prediction early stopping (inherently sequential over
+        trees), linear-tree models, empty slices, or
+        device_predict=false / auto without a TPU backend."""
+        cfg = self.config
+        mode = getattr(cfg, "device_predict", "false") if cfg else "false"
+        if mode == "false":
+            return None
+        if pred_early_stop and not self.average_output_:
+            return None
+        arr = X if isinstance(X, np.ndarray) else np.asarray(X)
+        if arr.dtype != np.float32:
+            return None
+        if mode == "auto" and jax.default_backend() != "tpu":
+            return None
+        self._sync_model()
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models_) // max(K, 1)
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = total_iters - start_iteration
+        end = min(start_iteration + num_iteration, total_iters)
+        if end <= start_iteration:
+            return None
+        dp = self._device_pred_for(start_iteration, end, K)
+        return dp if dp.ok else None
+
+    def _device_pred_for(self, start_iteration: int, end: int, K: int):
+        """Cached DevicePredictor per model slice, invalidated by growth
+        (len) and in-place mutation, mirroring _packed_for."""
+        from ..inference import DevicePredictor
+        key = (start_iteration, end, len(self.models_),
+               getattr(self, "_model_mutations", 0))
+        cached = getattr(self, "_device_pred", None)
+        if cached is None or cached[0] != key:
+            obj = self.objective
+            conv = obj.convert_output if obj is not None else None
+            mesh = None
+            if (getattr(self, "mesh", None) is not None
+                    and getattr(self, "_mesh_axis", 1) == 1
+                    and jax.process_count() == 1):
+                # offline scoring shards rows over the training mesh; the
+                # model replicates (each chip holds the whole ensemble)
+                mesh = self.mesh
+            cached = (key, DevicePredictor(
+                self.models_[start_iteration * K:end * K], num_class=K,
+                average=self.average_output_, convert=conv,
+                min_bucket=getattr(self.config, "device_predict_min_bucket",
+                                   4096),
+                mesh=mesh))
+            self._device_pred = cached
+        return cached[1]
+
+    def _device_predict_run(self, dp, X, mode: str) -> np.ndarray:
+        """One device predict dispatch + telemetry (timer scope and a
+        structured `predict` event when an EventLogger is active)."""
+        from ..observability import emit_event
+        with global_timer.scope("GBDT::predict_device"):
+            if mode == "leaf":
+                out = dp.predict_leaf(X)
+            elif mode == "raw":
+                out = dp.predict_raw(X)
+            else:
+                out = dp.predict(X)
+        n = out.shape[0]
+        emit_event("predict", path="device", mode=mode, rows=int(n),
+                   trees=dp.pack.num_trees, bucket=dp.bucket_rows(n))
+        return out
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, pred_early_stop: bool = False,
                     pred_early_stop_freq: int = 10,
@@ -1708,6 +1781,10 @@ class GBDT:
         early stopping per prediction_early_stop.cpp: rows whose margin
         exceeds the threshold every round_period iterations keep their
         partial sum — binary margin = 2|score|, multiclass = top1-top2)."""
+        dp = self._device_predictor(X, start_iteration, num_iteration,
+                                    pred_early_stop)
+        if dp is not None:
+            return self._device_predict_run(dp, X, "raw")
         with global_timer.scope("GBDT::predict"):
             return self._predict_raw_impl(
                 X, start_iteration, num_iteration, pred_early_stop,
@@ -1768,14 +1845,23 @@ class GBDT:
                 pred_leaf: bool = False, **pred_kwargs) -> np.ndarray:
         if pred_leaf:
             return self.predict_leaf_index(X, start_iteration, num_iteration)
+        if not raw_score and self.objective is not None:
+            dp = self._device_predictor(
+                X, start_iteration, num_iteration,
+                pred_kwargs.get("pred_early_stop", False))
+            if dp is not None:
+                # convert_output fused into the device program
+                return self._device_predict_run(dp, X, "convert")
         raw = self.predict_raw(X, start_iteration, num_iteration,
                                **pred_kwargs)
         if raw_score or self.objective is None:
             return raw
-        import jax.numpy as jnp_
+        # host path: the scores are already NumPy — use the objective's
+        # host converter instead of a host->device->host round trip
+        conv = self.objective.convert_output_host
         if raw.ndim == 2:
-            return np.asarray(self.objective.convert_output(jnp_.asarray(raw.T))).T
-        return np.asarray(self.objective.convert_output(jnp_.asarray(raw)))
+            return np.asarray(conv(raw.T)).T
+        return np.asarray(conv(raw))
 
     def _calculate_linear(self, tree: Tree, leaf_id: np.ndarray,
                           grad: np.ndarray, hess: np.ndarray) -> None:
@@ -1932,6 +2018,9 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
                            num_iteration: int = -1) -> np.ndarray:
+        dp = self._device_predictor(X, start_iteration, num_iteration)
+        if dp is not None:
+            return self._device_predict_run(dp, X, "leaf")
         self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration

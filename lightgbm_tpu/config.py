@@ -232,6 +232,15 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     ("precise_float_parser", "bool", False, ()),
     ("parser_config_file", "str", "", ()),
     # --- predict ---
+    # TPU-resident batch inference (docs/Inference.md): "auto" serves
+    # float32 batches through the jitted device traversal when a TPU
+    # backend is up, "true" forces it (any backend; float64 data still
+    # falls back — the exactness argument needs float32 inputs), "false"
+    # keeps every predict on the native/Python host paths
+    ("device_predict", "str", "auto", ()),
+    # smallest padded batch of the device predictor's bucket ladder;
+    # buckets double from here so varying request sizes never recompile
+    ("device_predict_min_bucket", "int", 4096, ("predict_min_bucket",)),
     ("start_iteration_predict", "int", 0, ()),
     ("num_iteration_predict", "int", -1, ()),
     ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),
@@ -409,6 +418,12 @@ class Config:
             # legacy alias: boosting=goss means gbdt + goss sampling (ref: boosting.cpp:26)
             self.boosting = "gbdt"
             self.data_sample_strategy = "goss"
+        dp = str(self.device_predict).strip().lower()
+        dp = {"1": "true", "yes": "true", "0": "false", "no": "false"}.get(dp, dp)
+        if dp not in ("auto", "true", "false"):
+            log.fatal(f"device_predict must be auto, true or false "
+                      f"(got {self.device_predict!r})")
+        self.device_predict = dp
 
     def to_dict(self) -> Dict[str, Any]:
         return {name: getattr(self, name) for name, _, _, _ in PARAMS}

@@ -1,0 +1,17 @@
+"""TPU-resident batch inference (docs/Inference.md).
+
+The first serving-side subsystem: a trained ensemble compiles to a jitted
+tensor traversal (Hummingbird / RAPIDS-FIL style flat-node layout over XLA
+gathers), with request batches padded to a bucket ladder so varying sizes
+never recompile, and rows sharded over the `parallel/` mesh for offline
+scoring.  `GBDT.predict` routes here behind the `device_predict` config
+param; host semantics (missing values, categorical bitsets, multiclass,
+average_output) are reproduced bit-identically in ROUTING for float32
+inputs — see docs/Inference.md for the exactness argument and the
+fallback matrix.
+"""
+
+from .pack import PackedEnsemble, pack_ensemble
+from .predictor import DevicePredictor
+
+__all__ = ["DevicePredictor", "PackedEnsemble", "pack_ensemble"]

@@ -1,0 +1,182 @@
+"""DevicePredictor: TPU-resident batch inference over a packed ensemble.
+
+Serving-side counterpart of the training engines: the trained model slice
+is packed once (pack.py), placed on device once, and every predict call is
+one jitted dispatch of the tensor traversal (traverse.py).
+
+Shape discipline (the part that makes this servable): a jitted program is
+specialized to its input SHAPES, so feeding raw request sizes would
+recompile per distinct batch size — a multi-second stall the PR-2
+RecompileDetector exists to catch.  Batches are instead padded up to a
+small geometric ladder of bucket sizes (min_bucket * 2^k), one compiled
+program per bucket; varying request sizes inside a bucket re-enter the
+SAME trace.  Each bucket's entry is wrapped in its own RecompileDetector,
+so the watchdog stays quiet in steady state and still fires if anything
+else (dtype, feature count) destabilizes the signature.  The padded input
+buffer is DONATED to the program, letting XLA reuse its pages for the
+output instead of holding both live.
+
+For offline scoring the row axis shards across chips through the existing
+`parallel/` 1-D mesh: the traversal is row-wise embarrassingly parallel,
+so GSPMD partitions it with zero collectives (the model arrays replicate,
+exactly like the reference workers each holding the whole model).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+from .pack import PackedEnsemble, pack_ensemble
+from .traverse import class_scores, ensemble_leaf_ids
+
+
+class DevicePredictor:
+    """Jitted ensemble predictor for one model slice.
+
+    Parameters
+    ----------
+    trees : the model slice (host Tree objects, shrinkage applied)
+    num_class : K — tree t scores class t % K
+    average : RF output averaging (divide class sums by trees-per-class)
+    convert : optional jittable score -> prediction map ([K, n] layout),
+        fused into the device program (objective.convert_output)
+    min_bucket : smallest padded batch; buckets double from here
+    mesh : optional jax.sharding.Mesh — shard rows for offline scoring
+    """
+
+    def __init__(self, trees: List, num_class: int = 1,
+                 average: bool = False, convert=None,
+                 min_bucket: int = 4096, mesh=None):
+        self.pack: Optional[PackedEnsemble] = pack_ensemble(trees)
+        self.ok = self.pack is not None and self.pack.num_trees > 0
+        self.num_class = max(int(num_class), 1)
+        self.average = bool(average)
+        self._convert = convert
+        self._mesh = mesh
+        self._min_bucket = max(int(min_bucket), 8)
+        if mesh is not None:
+            ndev = int(np.prod(mesh.devices.shape))
+            # buckets must tile the mesh; doubling preserves divisibility
+            self._min_bucket = max(
+                self._min_bucket,
+                ((self._min_bucket + ndev - 1) // ndev) * ndev)
+        self._dev = None      # device copies of the pack arrays
+        self._fns = {}        # (mode, bucket, F) -> RecompileDetector(jit)
+        self._x_sharding = None
+
+    # ------------------------------------------------------------- device
+    def _device_arrays(self):
+        """Put the pack on device once (replicated over the mesh when
+        sharding rows): 11 small transfers at first use, zero after."""
+        if self._dev is None:
+            import jax
+            import jax.numpy as jnp
+            p = self.pack
+            arrs = (p.split_feature, p.threshold, p.missing_type,
+                    p.default_left, p.is_cat, p.left, p.right,
+                    p.leaf_value, p.cat_start, p.cat_nwords, p.cat_words)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                repl = NamedSharding(self._mesh, P())
+                self._x_sharding = NamedSharding(
+                    self._mesh, P(self._mesh.axis_names[0], None))
+                self._dev = tuple(jax.device_put(a, repl) for a in arrs)
+            else:
+                self._dev = tuple(jnp.asarray(a) for a in arrs)
+        return self._dev
+
+    def bucket_rows(self, n: int) -> int:
+        """Smallest ladder size >= n (docs/Inference.md Bucketing)."""
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return b
+
+    def num_traces(self, mode: str = "raw") -> int:
+        """Distinct traced signatures across this predictor's compiled
+        bucket entries (the recompile-watchdog parity tests assert this
+        stays at one per touched bucket)."""
+        return sum(fn.signatures_seen for (m, _, _), fn in self._fns.items()
+                   if m == mode)
+
+    # ------------------------------------------------------------ program
+    def _program(self, mode: str):
+        p = self.pack
+        depth = p.max_depth
+        K = self.num_class
+        average = self.average
+        convert = self._convert
+
+        def run(x, sf, th, mt, dl, ic, lc, rc, lv, cs, cn, cw):
+            leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
+                                     cs, cn, cw, depth)
+            if mode == "leaf":
+                return leaf
+            scores = class_scores(leaf, lv, K, average)
+            if mode == "convert" and convert is not None:
+                # objectives convert in [K, n] layout (softmax over axis 0)
+                scores = convert(scores.T).T
+            return scores
+
+        return run
+
+    def _fn_for(self, mode: str, bucket: int, F: int):
+        key = (mode, bucket, F)
+        fn = self._fns.get(key)
+        if fn is None:
+            import jax
+            from ..observability import RecompileDetector
+            jitted = jax.jit(self._program(mode), donate_argnums=(0,))
+            fn = RecompileDetector(
+                jitted, f"device_predict[{mode}@{bucket}]")
+            self._fns[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ predict
+    def _run(self, X: np.ndarray, mode: str):
+        import jax
+        X = np.ascontiguousarray(X, np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        n, F = X.shape
+        if self.pack.max_feature >= F:
+            log.fatal(f"The model references feature index "
+                      f"{self.pack.max_feature} but the data has only "
+                      f"{F} columns")
+        bucket = self.bucket_rows(n)
+        if bucket != n:
+            xp = np.zeros((bucket, F), np.float32)
+            xp[:n] = X
+        else:
+            xp = X
+        xd = jax.device_put(xp, self._x_sharding)
+        with warnings.catch_warnings():
+            # CPU XLA cannot alias the donated [bucket, F] input into the
+            # differently-shaped output and warns at compile; on TPU the
+            # donation frees the input pages for scratch, which is the point
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            out = self._fn_for(mode, bucket, F)(xd, *self._device_arrays())
+        return np.asarray(out)[:n], bucket
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """[n, T] int32 leaf indices — bit-identical to the native
+        predictor's routing for float32 inputs."""
+        return self._run(X, "leaf")[0]
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Raw scores [n] (K == 1) or [n, K]; float32 accumulation of the
+        float64 leaf values (routing exact; see docs/Inference.md)."""
+        out, _ = self._run(X, "raw")
+        return out[:, 0] if self.num_class == 1 else out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Converted predictions with the objective's convert_output fused
+        on device (raw scores when no converter was given)."""
+        mode = "convert" if self._convert is not None else "raw"
+        out, _ = self._run(X, mode)
+        return out[:, 0] if self.num_class == 1 else out

@@ -847,7 +847,8 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # spike_k leaves (or Lg_main//2 for the first spike), so the
         # computed-slot bound is that previous wave's split cap
         KsS = min(spike_k if s_i > 0 else max(Lg_main // 2, 1), Lg)
-        state = state[:-1] + (jnp.asarray(True),)   # re-arm cont
+        # tpulint: disable-next=no-device-put-in-loop -- re-arm cont: trace-time constant in the unrolled spike ladder, not a runtime H2D
+        state = state[:-1] + (jnp.asarray(True),)
         state = jax.lax.cond(
             state[0].num_leaves < Lg,
             functools.partial(wave_body, NLp=wave_slot_pad(Lg),

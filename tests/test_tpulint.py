@@ -52,11 +52,11 @@ def _rules_of(report):
 
 
 # ------------------------------------------------------------ registry/CLI
-def test_registry_has_all_six_rules():
+def test_registry_has_all_rules():
     from tools.tpulint import rules as _  # noqa: F401
     assert {"no-host-sync-in-jit", "no-tracer-branch", "explicit-dtype",
             "collective-discipline", "no-bare-print",
-            "config-doc-sync"} <= set(RULES)
+            "config-doc-sync", "no-device-put-in-loop"} <= set(RULES)
 
 
 def test_cli_json_format_and_exit_codes(tmp_path):
@@ -142,6 +142,63 @@ def test_explicit_dtype_positives_and_negatives(tmp_path):
     assert _rules_of(rep) == [("ops/dev.py", 4, "explicit-dtype"),
                               ("ops/dev.py", 5, "explicit-dtype"),
                               ("ops/dev.py", 6, "explicit-dtype")]
+
+
+def test_explicit_dtype_covers_inference(tmp_path):
+    rep = _lint(tmp_path, {"inference/t.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)
+        """}, rules=["explicit-dtype"])
+    assert _rules_of(rep) == [("inference/t.py", 4, "explicit-dtype")]
+
+
+# ------------------------------------------------- no-device-put-in-loop
+def test_no_device_put_in_loop(tmp_path):
+    rep = _lint(tmp_path, {
+        "inference/b.py": """
+        import jax
+        import jax.numpy as jnp
+        def bad(batches):
+            out = []
+            for b in batches:
+                out.append(jax.device_put(b))       # flagged
+            i = 0
+            while i < 3:
+                x = jnp.asarray(batches[i])         # flagged
+                i += 1
+            return out, x
+        def ok(batches):
+            big = jnp.asarray(batches)              # one transfer, no loop
+            return [b * 2 for b in big]
+        def ok_comprehension(parts):
+            # comprehensions converting scalars are the benign form
+            return tuple(jnp.asarray(p) for p in parts)
+        """,
+        # host-side module: out of scope by design
+        "metric.py": """
+        import jax.numpy as jnp
+        def g(vals):
+            out = []
+            for v in vals:
+                out.append(jnp.asarray(v))
+            return out
+        """}, rules=["no-device-put-in-loop"])
+    assert _rules_of(rep) == [
+        ("inference/b.py", 7, "no-device-put-in-loop"),
+        ("inference/b.py", 10, "no-device-put-in-loop")]
+
+
+def test_no_device_put_in_loop_suppression(tmp_path):
+    rep = _lint(tmp_path, {"learner/m.py": """
+        import jax
+        def f(bs):
+            for b in bs:
+                x = jax.device_put(b)  # tpulint: disable=no-device-put-in-loop -- fixture
+            return x
+        """}, rules=["no-device-put-in-loop"])
+    assert not rep.active
+    assert len(rep.suppressed) == 1
 
 
 # ----------------------------------------------------- collective-discipline
