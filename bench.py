@@ -101,10 +101,17 @@ def _backend_guard() -> None:
     any Dataset/Booster device work.  If it fails despite the subprocess
     probe passing (flaky TPU runtime), re-exec this script pinned to CPU
     — jax caches the failed init for the process lifetime, so switching
-    platforms in-process would not recover."""
+    platforms in-process would not recover.
+
+    The guard runs a REAL device op, not just jax.devices(): BENCH_r05's
+    `Unable to initialize backend 'axon'` surfaced only at the first
+    jax.device_put, inside the previously-unguarded region, after a
+    devices() enumeration had already succeeded."""
     import jax
     try:
         jax.devices()
+        x = jax.device_put(np.ones(8, np.float32))
+        float(jax.numpy.sum(x + 1.0))
     except RuntimeError as e:
         if os.environ.get("_BENCH_CPU_REEXEC") == "1":
             raise  # already on the CPU fallback; give up loudly
@@ -156,7 +163,8 @@ def diff_main(path_a, path_b):
         print(f"headline: {va} -> {vb} {a.get('unit', 's/iter')} "
               f"({vb / va:.3f}x; {'faster' if vb < va else 'slower'} B)")
     for key in ("auc", "quality_mode_sec_per_iter", "quality_mode_auc",
-                "peak_device_bytes", "backend"):
+                "peak_device_bytes", "backend", "host_block_ms_per_iter",
+                "setup_construct_s", "setup_compile_s"):
         if a.get(key) is not None or b.get(key) is not None:
             print(f"{key}: {a.get(key)} -> {b.get(key)}")
     return 0
@@ -188,8 +196,9 @@ def _predict_throughput(booster, X):
     prev_mode = g.config.device_predict
     try:
         g.config.device_predict = "true"
-        dp = g._device_predictor(Xd, 0, -1)
-        if dp is not None:
+        hit = g._device_predictor(Xd, 0, -1)
+        if hit is not None:
+            dp, Xd = hit
             out["device"] = timed(lambda: dp.predict_raw(Xd), n_dev)
             out["device_rows"] = n_dev
     except Exception as e:  # noqa: BLE001 - throughput must not kill bench
@@ -242,15 +251,27 @@ def main():
         "max_bin": int(os.environ.get("BENCH_BINS", 255)),
         "min_data_in_leaf": 20,
         "verbosity": -1,
-        "metric": "none",
+        # the timed loop never evaluates (headline comparability); the
+        # metric exists for the instrumented eval-tick phase below
+        "metric": "binary_logloss",
     }
+    # setup split (ISSUE 5): construct = binning + device placement +
+    # booster init; compile = first update through its device sync (the
+    # part a persistent compilation cache removes on repeat runs —
+    # enable with compile_cache_dir=<dir>)
+    t0 = time.time()
     train_set = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=train_set)
+    setup_construct_s = time.time() - t0
 
     # warmup: the first iteration compiles the whole-tree program and the
     # first post-compile execution pays one-time device autotuning; sync
     # before timing so the measured loop is steady-state
-    for _ in range(WARMUP):
+    t0 = time.time()
+    booster.update()
+    _ = np.asarray(booster._gbdt.scores[0][:8])
+    setup_compile_s = time.time() - t0
+    for _ in range(WARMUP - 1):
         booster.update()
     _ = np.asarray(booster._gbdt.scores[0][:8])
     t0 = time.time()
@@ -276,9 +297,24 @@ def main():
     global_timer.reset()
     for _ in range(3):
         booster.update()
+        # eval tick, mirroring engine.train's scope: with device eval
+        # this is ONE packed D2H (ops/metrics.py); its cost is the
+        # host-block headline below
+        with global_timer.scope("GBDT::eval"):
+            booster.eval_train()
     _ = np.asarray(booster._gbdt.scores[0][:8])
+    all_scopes = global_timer.items()
     timer_top = [[name, round(sec * 1000, 3), cnt]
-                 for name, sec, cnt in global_timer.items()[:10]]
+                 for name, sec, cnt in all_scopes[:10]]
+    # host-block attribution (docs/Observability.md): the scopes that
+    # synchronize the training thread on device results or host I/O —
+    # the boundary the ISSUE-5 work shrinks (device eval metrics, async
+    # checkpoint writer, pipelined tree materialization)
+    _HOST_BLOCK_SCOPES = ("GBDT::eval", "GBDT::materialize_tree",
+                          "Checkpoint::save")
+    host_block_ms_per_iter = round(sum(
+        sec * 1000 for name, sec, _cnt in all_scopes
+        if name in _HOST_BLOCK_SCOPES) / 3.0, 3)
     global_timer.enabled = timer_prev
     global_timer.reset()
 
@@ -333,6 +369,13 @@ def main():
         "kernel_checks": kernel_checks,
         "backend": jax.default_backend(),
         "backend_fallback": backend_fallback,
+        # setup split: construct (binning + placement + init) vs the
+        # first-update compile a persistent compile_cache_dir removes
+        "setup_construct_s": round(setup_construct_s, 3),
+        "setup_compile_s": round(setup_compile_s, 3),
+        # host-blocking ms per instrumented iteration (eval tick +
+        # pipelined tree materialization + checkpoint capture)
+        "host_block_ms_per_iter": host_block_ms_per_iter,
         # where the time goes: [scope, total_ms, calls] over 3
         # instrumented post-loop iterations (top scopes first)
         "timer_top_ms": timer_top,

@@ -21,7 +21,9 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.binning import BIN_CATEGORICAL
 from ..io.dataset import Dataset
-from ..learner import FeatureMeta, GrowParams, grow_tree, grow_tree_wave
+from ..learner import (FeatureMeta, GrowParams, grow_tree,
+                       grow_tree_donated, grow_tree_wave,
+                       grow_tree_wave_donated)
 from ..models.tree import Tree
 from ..objective import ObjectiveFunction
 from ..ops.split import SplitParams
@@ -34,15 +36,25 @@ from ..utils.timer import global_timer
 K_EPSILON = 1e-15
 _PAD = 1024  # row padding multiple (histogram chunking requirement)
 
+# score/gradient buffers are donated through the jitted update entries
+# (docs/Performance.md); CPU XLA cannot alias every donated buffer and
+# warns per executable — same silencing as inference/predictor.py
+import warnings as _warnings  # noqa: E402
+
+_warnings.filterwarnings("ignore",
+                         message="Some donated buffers were not usable")
+
 # sentinel stored in models_ for device trees not yet pulled to host
 _PENDING_TREE = object()
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"),
+                   donate_argnums=(0, 1))
 def _goss_sample(grad, hess, pad_mask, key, top_k, other_k):
     """Gradient one-side sampling on device (ref: goss.hpp:118-165):
     keep the top_k rows by sum_k |g*h|, Bernoulli-sample ~other_k of the rest
-    and amplify them by (n_kept_pool)/other_k."""
+    and amplify them by (n_kept_pool)/other_k.  The incoming grad/hess
+    are replaced by the rescaled outputs, so their buffers are donated."""
     imp = jnp.sum(jnp.abs(grad * hess), axis=0) * pad_mask
     thr = jax.lax.top_k(imp, top_k)[0][-1]
     is_top = (imp >= thr) & (pad_mask > 0)
@@ -160,6 +172,8 @@ class GBDT:
         self.best_iteration = -1
         self._pending = []       # device trees awaiting host materialization
         self._stump_idxs = set()  # model indices of no-split trees
+        self._device_eval = None  # lazy ops.metrics.DeviceEval
+        self._finite_cache = None  # (grads_finite, scores_finite) this iter
 
     # ------------------------------------------------------------ distributed
     def _make_training_mesh(self, config: Config):
@@ -228,6 +242,12 @@ class GBDT:
     def init(self, config: Config, train_data: Dataset,
              objective: Optional[ObjectiveFunction],
              metrics: Sequence[Metric]) -> None:
+        if config.compile_cache_dir:
+            # persistent XLA compilation cache: repeat runs of the same
+            # config skip the multi-minute ladder compile; must be wired
+            # before the first jit below traces (docs/Performance.md)
+            from ..observability import configure_compile_cache
+            configure_compile_cache(config.compile_cache_dir)
         self.config = config
         self.train_data = train_data
         self.objective = objective
@@ -612,6 +632,11 @@ class GBDT:
                         "histogram falls back to the XLA one-hot wave "
                         "histogram, which materializes [F, n, B] — only "
                         "viable for small datasets")
+        # grad/hess buffer donation into the grow program
+        # (docs/Performance.md): the per-class slices die at the grow
+        # call in every configuration except linear trees, whose leaf
+        # fitting re-reads them afterwards
+        donate_grow = (config.tpu_donate_buffers and not config.linear_tree)
         if strategy == "wave" and (self.mesh is not None
                                    and self._mesh_axis == 1
                                    and self.grow_params.voting is None):
@@ -619,16 +644,19 @@ class GBDT:
             # mesh via shard_map + histogram psum (the reference's
             # ReduceScatter path, data_parallel_tree_learner.cpp:282)
             from ..parallel import make_sharded_wave_fn
-            self._grow_fn = make_sharded_wave_fn(self.mesh)
+            self._grow_fn = make_sharded_wave_fn(self.mesh,
+                                                 donate=donate_grow)
         elif strategy == "wave":
-            self._grow_fn = grow_tree_wave
+            self._grow_fn = (grow_tree_wave_donated if donate_grow
+                             else grow_tree_wave)
         else:
             if self.mesh is not None and self._mesh_axis == 1:
                 # leaf-wise under a row mesh rides GSPMD annotations,
                 # which cannot partition a pallas_call
                 self.grow_params = self.grow_params._replace(
                     hist_method="segment")
-            self._grow_fn = grow_tree
+            self._grow_fn = (grow_tree_donated if donate_grow
+                             else grow_tree)
         self.growth_strategy = strategy
         # recompile watchdog (docs/Observability.md): a mid-training
         # shape change on a jitted hot-path entry re-traces the whole
@@ -690,12 +718,38 @@ class GBDT:
             m.init(md, n)
         self.init_scores_applied = [0.0] * K
 
+        # ---- host-boundary machinery (docs/Performance.md) ----
+        # device eval metrics: built lazily on the first eval tick
+        # (ops/metrics.py); _finite_cache carries the sentinel flags
+        # fetched with (or instead of) that tick's packed vector
+        self._device_eval = None
+        self._finite_cache = None
+        self._true_flag = jnp.asarray(True)
+
+        # tpulint: disable-next=donate-argnums -- read-only sentinel reduction; the boosting loop keeps updating the score buffer
         @jax.jit
+        def _finite_flags(scores, grad_ok):
+            return jnp.stack([grad_ok.astype(jnp.float32),
+                              jnp.all(jnp.isfinite(scores))
+                              .astype(jnp.float32)])
+        self._finite_flags_fn = _finite_flags
+        # private device-side copy for async checkpointing: the live
+        # buffer may be DONATED to the next update while the writer
+        # thread is still fetching, so snapshots fetch their own copy
+        # tpulint: disable-next=donate-argnums -- the point is a second live copy; donating would delete the source buffer
+        self._snapshot_scores_fn = jax.jit(lambda scores: scores + 0.0)
+        # Donation: the per-iteration score updates consume the old
+        # buffer and produce its replacement — donating lets XLA reuse
+        # the HBM allocation instead of copying [K, n_pad] every tree
+        # (enforced package-wide by the tpulint donate-argnums rule).
+        _donate0 = (0,) if config.tpu_donate_buffers else ()
+
         def _score_update(scores, class_id, leaf_vals, leaf_id, pad_mask):
             delta = jnp.take(leaf_vals,
                              jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
             return scores.at[class_id].add(delta * pad_mask)
-        self._score_update_fn = _score_update
+        self._score_update_fn = jax.jit(_score_update,
+                                        donate_argnums=_donate0)
 
         @jax.jit
         def _pack_tree(t):
@@ -736,15 +790,16 @@ class GBDT:
         self._slice_row_fn = jax.jit(
             lambda a, k: jax.lax.dynamic_index_in_dim(a, k, 0,
                                                       keepdims=False))
-        self._score_add_fn = jax.jit(lambda sc, k, v: sc.at[k].add(v))
+        self._score_add_fn = jax.jit(lambda sc, k, v: sc.at[k].add(v),
+                                     donate_argnums=_donate0)
 
-        @jax.jit
         def _score_update_shrink(scores, class_id, leaf_vals, rate,
                                  leaf_id, pad_mask):
             delta = jnp.take(leaf_vals * rate,
                              jnp.clip(leaf_id, 0, leaf_vals.shape[0] - 1))
             return scores.at[class_id].add(delta * pad_mask)
-        self._score_update_shrink_fn = _score_update_shrink
+        self._score_update_shrink_fn = jax.jit(_score_update_shrink,
+                                               donate_argnums=_donate0)
         # ---- quantized training (ref: gradient_discretizer.{hpp,cpp};
         # config use_quantized_grad/num_grad_quant_bins/stochastic_rounding).
         # Gradients/hessians are snapped to the reference's integer grid on
@@ -794,6 +849,7 @@ class GBDT:
                       else jnp.trunc(hess / hscale + rh))
                 return (gi * gscale, hi * hscale,
                         jnp.stack([gscale, hscale]))
+            # tpulint: disable-next=donate-argnums -- the float grad/hess slices are reused for leaf renewal (float_grads) after discretization
             self._discretize_fn = jax.jit(_disc)
             if config.quant_train_renew_leaf:
                 renew_p = SplitParams(
@@ -811,7 +867,12 @@ class GBDT:
                     out = leaf_output(sg, sh, jnp.zeros(L, jnp.float32),
                                       0.0, renew_p)
                     return jnp.where(sh > 0, out, leaf_value)
-                self._renew_quant_fn = jax.jit(_renew)
+                # the float grad/hess slices die here: renewal is their
+                # last consumer, so their buffers are donated
+                self._renew_quant_fn = jax.jit(
+                    _renew, donate_argnums=((2, 3)
+                                            if config.tpu_donate_buffers
+                                            else ()))
 
         if has_cegb:
             F_used = len(nb)
@@ -883,25 +944,58 @@ class GBDT:
             vraw = vraw[:, None] if vraw.ndim == 1 else vraw
             self.valid_scores[vi] += vraw.T
 
+    def _ensure_finite_flags(self):
+        """(gradients_finite, scores_finite) for the current iteration.
+        The device eval tick folds both flags into its packed fetch
+        (ops/metrics.py); when no device eval ran this iteration, one
+        dedicated tiny [2]-vector fetch computes them — either way the
+        sentinel never pulls score samples to host (it used to fetch
+        scores[:, :256])."""
+        if self._finite_cache is None:
+            flag = getattr(self, "_grad_ok", None)
+            if flag is None:
+                flag = self._true_flag
+            flags = _fetch_host(self._finite_flags_fn(self.scores, flag))
+            self._finite_cache = (bool(flags[0] > 0), bool(flags[1] > 0))
+        return self._finite_cache
+
     def gradients_finite(self) -> bool:
-        """Fetch the accumulated device-side gradient-finiteness flag
-        (one host sync; called by engine.train's non-finite sentinel)."""
-        flag = getattr(self, "_grad_ok", None)
-        return True if flag is None else bool(flag)
+        """Accumulated device-side gradient-finiteness flag (engine
+        sentinel; one shared host fetch per check tick)."""
+        return self._ensure_finite_flags()[0]
+
+    def scores_finite(self) -> bool:
+        """Device-side all-finite reduction over the full score buffer
+        (engine sentinel; rides the same fetch as gradients_finite)."""
+        return self._ensure_finite_flags()[1]
 
     # ------------------------------------------------------- checkpoint state
-    def capture_train_state(self):
+    def capture_train_state(self, async_copy: bool = False):
         """Exact trainer state for CheckpointManager: the float32 score
         buffer plus the stateful sampling RNGs.  Model text alone is not
         enough for byte-identical resume — re-seeding scores from
         predictions differs from the accumulated buffer in ulps, which
         changes later trees.  Returns None when the scores span
         non-addressable devices (multi-process SPMD): resume then falls
-        back to predict-based seeding, which is rank-deterministic."""
+        back to predict-based seeding, which is rank-deterministic.
+
+        With `async_copy` (the async checkpoint writer,
+        docs/Performance.md) the scores stay a DEVICE array in the
+        returned dict: a private snapshot copy whose D2H transfer is
+        started here and completed by whoever serializes the state —
+        the training thread never blocks on the fetch, and the live
+        buffer is free to be donated to the next update meanwhile."""
         sc = self.scores
         if isinstance(sc, jax.Array) and not sc.is_fully_addressable:
             return None
-        state = {"scores": np.asarray(sc),
+        if async_copy and isinstance(sc, jax.Array):
+            sc = self._snapshot_scores_fn(sc)
+            copy_async = getattr(sc, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        else:
+            sc = np.asarray(sc)
+        state = {"scores": sc,
                  "num_data": np.int64(self.num_data),
                  "rng_bag": np.array(self._rng_bag.get_state(legacy=False),
                                      dtype=object),
@@ -1068,6 +1162,8 @@ class GBDT:
         K = self.num_tree_per_iteration
         if faults.active():
             faults.maybe_crash(self.num_init_iteration_ + self.iter_)
+        # sentinel flags fetched for the previous iteration are stale now
+        self._finite_cache = None
         init_scores = [0.0] * K
         if gradients is None:
             for k in range(K):
@@ -1501,6 +1597,20 @@ class GBDT:
         if (isinstance(self.scores, jax.Array)
                 and not self.scores.is_fully_addressable):
             return self._eval_train_sharded()
+        de = self._device_eval
+        if de is None:
+            from ..ops.metrics import DeviceEval
+            de = self._device_eval = DeviceEval(self)
+        if de.ok:
+            if not de._plans:
+                return []
+            out, grads_ok, scores_ok = de.run(self.scores,
+                                              getattr(self, "_grad_ok",
+                                                      None))
+            # the sentinel flags rode the packed fetch: cache them so
+            # this tick's _check_finite costs no second sync
+            self._finite_cache = (grads_ok, scores_ok)
+            return out
         score = np.asarray(self.scores)[:, :self.num_data].astype(np.float64)
         return self._eval(score, self.train_metrics, self.train_data)
 
@@ -1633,6 +1743,7 @@ class GBDT:
                         outs.append(jnp.sqrt(v) if kind == "sqrt" else v)
                 return tuple(outs)
 
+            # tpulint: disable-next=donate-argnums -- eval reads the live sharded score buffer; training keeps updating it
             self._sharded_eval_fn = jax.jit(_fn)
         vals = self._sharded_eval_fn(self.scores, self._eval_label_dev,
                                      self._eval_weight_dev, self.pad_mask,
@@ -1703,12 +1814,15 @@ class GBDT:
     def _device_predictor(self, X, start_iteration: int, num_iteration: int,
                           pred_early_stop: bool = False):
         """Route decision for the TPU-resident inference path
-        (docs/Inference.md fallback matrix).  Returns a ready
-        DevicePredictor, or None when the host paths must serve:
-        float64 data (the bit-exact routing argument needs float32
-        inputs), prediction early stopping (inherently sequential over
-        trees), linear-tree models, empty slices, or
-        device_predict=false / auto without a TPU backend."""
+        (docs/Inference.md fallback matrix).  Returns (DevicePredictor,
+        float32 matrix) ready to serve, or None when the host paths
+        must: float64 data that is NOT losslessly f32-representable
+        (the bit-exact routing argument needs float32 inputs; lossless
+        float64 — integral features, f32-round-tripped pipelines — is
+        downcast and served, the ROADMAP'd Serving follow-up),
+        prediction early stopping (inherently sequential over trees),
+        linear-tree models, empty slices, or device_predict=false /
+        auto without a TPU backend."""
         cfg = self.config
         mode = getattr(cfg, "device_predict", "false") if cfg else "false"
         if mode == "false":
@@ -1716,7 +1830,16 @@ class GBDT:
         if pred_early_stop and not self.average_output_:
             return None
         arr = X if isinstance(X, np.ndarray) else np.asarray(X)
-        if arr.dtype != np.float32:
+        if arr.dtype == np.float32:
+            X32 = arr
+        elif arr.dtype == np.float64:
+            # cheap host check: one downcast + one compare pass.  Equal
+            # after the round trip (NaN kept as missing) means the f32
+            # traversal routes bit-identically to the float64 host path.
+            X32 = arr.astype(np.float32)
+            if not bool(np.all((X32 == arr) | np.isnan(arr))):
+                return None
+        else:
             return None
         if mode == "auto" and jax.default_backend() != "tpu":
             return None
@@ -1729,7 +1852,7 @@ class GBDT:
         if end <= start_iteration:
             return None
         dp = self._device_pred_for(start_iteration, end, K)
-        return dp if dp.ok else None
+        return (dp, X32) if dp.ok else None
 
     def _device_pred_for(self, start_iteration: int, end: int, K: int):
         """Cached DevicePredictor per model slice, invalidated by growth
@@ -1781,10 +1904,10 @@ class GBDT:
         early stopping per prediction_early_stop.cpp: rows whose margin
         exceeds the threshold every round_period iterations keep their
         partial sum — binary margin = 2|score|, multiclass = top1-top2)."""
-        dp = self._device_predictor(X, start_iteration, num_iteration,
-                                    pred_early_stop)
-        if dp is not None:
-            return self._device_predict_run(dp, X, "raw")
+        hit = self._device_predictor(X, start_iteration, num_iteration,
+                                     pred_early_stop)
+        if hit is not None:
+            return self._device_predict_run(hit[0], hit[1], "raw")
         with global_timer.scope("GBDT::predict"):
             return self._predict_raw_impl(
                 X, start_iteration, num_iteration, pred_early_stop,
@@ -1846,12 +1969,12 @@ class GBDT:
         if pred_leaf:
             return self.predict_leaf_index(X, start_iteration, num_iteration)
         if not raw_score and self.objective is not None:
-            dp = self._device_predictor(
+            hit = self._device_predictor(
                 X, start_iteration, num_iteration,
                 pred_kwargs.get("pred_early_stop", False))
-            if dp is not None:
+            if hit is not None:
                 # convert_output fused into the device program
-                return self._device_predict_run(dp, X, "convert")
+                return self._device_predict_run(hit[0], hit[1], "convert")
         raw = self.predict_raw(X, start_iteration, num_iteration,
                                **pred_kwargs)
         if raw_score or self.objective is None:
@@ -2018,9 +2141,9 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
                            num_iteration: int = -1) -> np.ndarray:
-        dp = self._device_predictor(X, start_iteration, num_iteration)
-        if dp is not None:
-            return self._device_predict_run(dp, X, "leaf")
+        hit = self._device_predictor(X, start_iteration, num_iteration)
+        if hit is not None:
+            return self._device_predict_run(hit[0], hit[1], "leaf")
         self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration

@@ -24,6 +24,13 @@ class RF(GBDT):
     average_output_ = True
 
     def init(self, config, train_data, objective, metrics) -> None:
+        if config.data_sample_strategy == "goss":
+            # GOSS reweights gradients per iteration; RF reuses ONE
+            # gradient map for every tree (rf.hpp:95 Boosting computes
+            # once) — the combination is meaningless, and the goss
+            # sampler donates its inputs, which would consume the
+            # persistent RF gradient buffers
+            log.fatal("RF mode does not support data_sample_strategy=goss")
         if config.data_sample_strategy == "bagging":
             ok = ((config.bagging_freq > 0
                    and 0.0 < config.bagging_fraction < 1.0)
@@ -71,7 +78,8 @@ class RF(GBDT):
         """ref: rf.hpp:117 TrainOneIter — never stops, never shrinks."""
         if gradients is not None or hessians is not None:
             log.fatal("RF mode does not support custom objective functions")
-
+        # sentinel flags fetched for the previous iteration are stale now
+        self._finite_cache = None
         K = self.num_tree_per_iteration
         bag_mask, grad, hess = self._update_bagging(self._rf_grad,
                                                     self._rf_hess)

@@ -106,17 +106,21 @@ def checkpoint(checkpoint_dir: str, frequency: int = 1, keep_last: int = 3,
             from .reliability.checkpoint import hash_params
             mgr.params_hash = hash_params(env.params)
         from .observability import emit_event, global_registry
-        try:
-            ck = mgr.save(env.model, it)
-            global_registry.inc("checkpoint_writes")
-            emit_event("checkpoint", iteration=it, path=ck.model_path)
-        except OSError as e:
-            global_registry.inc("checkpoint_failures")
-            emit_event("checkpoint_write_failed", iteration=it,
-                       error=str(e))
-            log.warning(f"Checkpoint write failed at iteration {it}: {e}; "
-                        "training continues (the previous checkpoint is "
-                        "intact)")
+
+        def _on_done(ok, err, ck):
+            # shared accounting for both write modes: in async mode this
+            # fires from the writer thread once the files land (or fail)
+            if ok:
+                global_registry.inc("checkpoint_writes")
+                emit_event("checkpoint", iteration=it, path=ck.model_path)
+            else:
+                global_registry.inc("checkpoint_failures")
+                emit_event("checkpoint_write_failed", iteration=it,
+                           error=str(err))
+                log.warning(f"Checkpoint write failed at iteration {it}: "
+                            f"{err}; training continues (the previous "
+                            "checkpoint is intact)")
+        mgr.save(env.model, it, on_done=_on_done)
     _callback.order = 40
     return _callback
 

@@ -200,6 +200,20 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # bracket training with jax.profiler.start_trace/stop_trace for
     # TensorBoard device timelines
     ("profile_dir", "str", "", ("trace_dir",)),
+    # --- host-boundary performance (docs/Performance.md) ---
+    # persistent XLA compilation cache: repeat runs of the same config
+    # skip the multi-minute ladder compile (cache-hit/miss counters land
+    # in the metrics registry as compile_cache_hits / _misses)
+    ("compile_cache_dir", "str", "", ("compilation_cache_dir",)),
+    # drain JSONL event appends and checkpoint serialization through a
+    # bounded single-worker writer thread so the training loop never
+    # blocks on host I/O; false = synchronous writes (byte-identical
+    # output either way)
+    ("async_host_io", "bool", True, ("async_host_services",)),
+    # in-jit eval metrics over the device score buffers (one packed D2H
+    # per eval tick): "auto"/"true" = device forms when every configured
+    # metric has one, "false" = host NumPy metric path
+    ("device_eval", "str", "auto", ("device_eval_metrics",)),
     ("use_quantized_grad", "bool", False, ()),
     ("num_grad_quant_bins", "int", 4, ()),
     ("quant_train_renew_leaf", "bool", False, ()),
@@ -424,6 +438,12 @@ class Config:
             log.fatal(f"device_predict must be auto, true or false "
                       f"(got {self.device_predict!r})")
         self.device_predict = dp
+        de = str(self.device_eval).strip().lower()
+        de = {"1": "true", "yes": "true", "0": "false", "no": "false"}.get(de, de)
+        if de not in ("auto", "true", "false"):
+            log.fatal(f"device_eval must be auto, true or false "
+                      f"(got {self.device_eval!r})")
+        self.device_eval = de
 
     def to_dict(self) -> Dict[str, Any]:
         return {name: getattr(self, name) for name, _, _, _ in PARAMS}

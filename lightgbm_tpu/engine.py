@@ -21,7 +21,10 @@ def _check_finite(booster: Booster, evals, iteration: int,
                   check_scores: bool) -> None:
     """Non-finite sentinel (reliability pillar 3): NaN gradients or eval
     scores mean every subsequent tree is garbage — fail fast instead of
-    silently training on."""
+    silently training on.  Both device-side flags (gradients and the
+    FULL score buffer, not the old 256-row host sample) ride the eval
+    tick's packed fetch when device metrics are on — the sentinel costs
+    no extra host sync (docs/Performance.md)."""
     for name, metric, value, _ in evals:
         if value != value:  # NaN
             raise NonFiniteError(
@@ -39,8 +42,7 @@ def _check_finite(booster: Booster, evals, iteration: int,
                 "Check the objective/labels for invalid values (or resume "
                 "from a checkpoint). Set nonfinite_check_freq=0 to disable "
                 "this sentinel.")
-        sample = np.asarray(booster._gbdt.scores[:, :256])
-        if not np.all(np.isfinite(sample)):
+        if not booster._gbdt.scores_finite():
             raise NonFiniteError(
                 f"Non-finite training scores detected at iteration "
                 f"{iteration + 1}: the gradients or tree outputs contain "
@@ -89,9 +91,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
         checkpoint_freq = cfg.checkpoint_freq
     if resume is None:
         resume = cfg.resume
+    # async host services (docs/Performance.md): one bounded writer
+    # thread drains event-log appends and checkpoint serialization so
+    # the training loop never blocks on host I/O; `async_host_io=false`
+    # restores synchronous writes (byte-identical output either way)
+    writer = None
+    if cfg.async_host_io and (checkpoint_dir or metrics_dir
+                              or cfg.metrics_dir):
+        from .observability import AsyncWriter
+        writer = AsyncWriter()
     ckpt_mgr = (CheckpointManager(checkpoint_dir,
                                   keep_last=cfg.checkpoint_keep,
-                                  params=params)
+                                  params=params, writer=writer)
                 if checkpoint_dir else None)
 
     # ---- observability setup (docs/Observability.md) ----
@@ -103,7 +114,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if metrics_dir:
         from .observability import EventLogger, set_event_logger
         event_logger = EventLogger(metrics_dir,
-                                   rotate_mb=cfg.metrics_rotate_mb)
+                                   rotate_mb=cfg.metrics_rotate_mb,
+                                   writer=writer)
         set_event_logger(event_logger)
         # the per-iteration phase breakdown diffs global_timer snapshots;
         # a metrics run therefore always times (restored afterwards)
@@ -255,6 +267,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 for name, metric, value, _ in e.best_score:
                     booster.best_score.setdefault(name, {})[metric] = value
             except NonFiniteError as e:
+                if writer is not None:
+                    # an async checkpoint may still be in flight: land it
+                    # before deciding where to roll back to
+                    writer.flush()
                 ck = (ckpt_mgr.resumable(params) if ckpt_mgr is not None
                       else None)
                 if ck is None or rollbacks >= 1:
@@ -280,9 +296,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for name, metric, value, _ in evals:
                 booster.best_score.setdefault(name, {})[metric] = value
         if event_logger is not None:
+            if writer is not None:
+                # land any in-flight checkpoint (and its event) first so
+                # train_end stays the log's terminal record
+                writer.flush()
+            from .observability import global_registry
             event_logger.emit(
                 "train_end", total_iterations=booster.current_iteration(),
-                best_iteration=booster.best_iteration)
+                best_iteration=booster.best_iteration,
+                # post-flush counter snapshot: per-iteration counters can
+                # lag async checkpoint writes; this one is settled
+                counters=global_registry.snapshot()["counters"])
         return booster
     finally:
         global_timer.enabled = timer_was_enabled
@@ -292,6 +316,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 jax.profiler.stop_trace()
             except Exception as e:
                 log.warning(f"jax profiler stop_trace failed: {e}")
+        if writer is not None:
+            # drain queued events/checkpoints on train end AND on error
+            # (a crashed run's log stays complete up to the failure)
+            writer.close()
         if event_logger is not None:
             from .observability import set_event_logger
             set_event_logger(None)

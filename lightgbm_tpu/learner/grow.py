@@ -306,12 +306,12 @@ def _pending_set(p: _PendingSplits, idx, res: SplitResult) -> _PendingSplits:
         cat_bitset=p.cat_bitset.at[idx].set(res.cat_bitset))
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-              row_mask: jnp.ndarray, col_mask: jnp.ndarray, meta: FeatureMeta,
-              params: GrowParams, cegb_used: jnp.ndarray = None,
-              extra_tag: jnp.ndarray = None,
-              lazy_used: jnp.ndarray = None):
+def grow_tree_impl(binned: jnp.ndarray, grad: jnp.ndarray,
+                   hess: jnp.ndarray, row_mask: jnp.ndarray,
+                   col_mask: jnp.ndarray, meta: FeatureMeta,
+                   params: GrowParams, cegb_used: jnp.ndarray = None,
+                   extra_tag: jnp.ndarray = None,
+                   lazy_used: jnp.ndarray = None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -1160,6 +1160,18 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # driver can thread it into the next tree
         return state.tree, state.leaf_id, state.lazy_used
     return state.tree, state.leaf_id
+
+
+# two jit entries over the same tracer program: the boosting loop's
+# default donates the per-class grad/hess slices (their buffers die
+# here — XLA reuses the HBM for the tree program's scratch instead of
+# holding both), while linear-tree training, which re-reads the slices
+# for leaf fitting after growth, keeps the non-donating entry
+# (boosting/gbdt.py selects; docs/Performance.md)
+# tpulint: disable-next=donate-argnums -- linear-tree training reuses grad/hess after growth; the default loop takes grow_tree_donated
+grow_tree = jax.jit(grow_tree_impl, static_argnames=("params",))
+grow_tree_donated = jax.jit(grow_tree_impl, static_argnames=("params",),
+                            donate_argnums=(1, 2))
 
 
 def make_grow_tree(params: GrowParams):

@@ -74,13 +74,13 @@ def _hist_wave_xla(binned_fm, slot, gh, *, max_bin, num_slots):
     return hist, counts
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-                   row_mask: jnp.ndarray, col_mask: jnp.ndarray,
-                   meta: FeatureMeta, params: GrowParams,
-                   cegb_used: jnp.ndarray = None,
-                   extra_tag: jnp.ndarray = None,
-                   quant_scales: jnp.ndarray = None):
+def grow_tree_wave_impl(binned: jnp.ndarray, grad: jnp.ndarray,
+                        hess: jnp.ndarray, row_mask: jnp.ndarray,
+                        col_mask: jnp.ndarray,
+                        meta: FeatureMeta, params: GrowParams,
+                        cegb_used: jnp.ndarray = None,
+                        extra_tag: jnp.ndarray = None,
+                        quant_scales: jnp.ndarray = None):
     """Grow one tree by waves.  Same contract as grow.grow_tree."""
     from ..ops.split import MISSING_NAN, MISSING_ZERO
 
@@ -1068,3 +1068,12 @@ def grow_tree_wave(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             leaf_count=tree.leaf_count[:L], leaf_parent=tree.leaf_parent[:L],
             leaf_depth=tree.leaf_depth[:L])
     return tree, leaf_id
+
+# tpulint: disable-next=donate-argnums -- the shard_map wrapper (parallel/data_parallel.py) and linear-tree paths reuse grad/hess; the default loop takes grow_tree_wave_donated
+grow_tree_wave = jax.jit(grow_tree_wave_impl, static_argnames=("params",))
+# default single-device entry: the per-class grad/hess slices die at the
+# grow call, so their HBM is donated into the tree program
+# (boosting/gbdt.py selects; docs/Performance.md)
+grow_tree_wave_donated = jax.jit(grow_tree_wave_impl,
+                                 static_argnames=("params",),
+                                 donate_argnums=(1, 2))

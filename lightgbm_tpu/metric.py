@@ -39,9 +39,13 @@ class Metric:
         raise NotImplementedError
 
     def _convert(self, score, objective):
+        # host metrics evaluate host-resident scores (valid sets, loaded
+        # boosters): convert on host too — the old jnp round trip cost
+        # one H2D + one D2H per (dataset, metric) every eval tick and
+        # quietly downcast the float64 valid scores to f32
+        # (docs/Performance.md host-boundary inventory)
         if objective is not None:
-            import jax.numpy as jnp
-            return np.asarray(objective.convert_output(jnp.asarray(score)))
+            return np.asarray(objective.convert_output_host(score))
         return score
 
     def _avg(self, pointwise: np.ndarray) -> float:

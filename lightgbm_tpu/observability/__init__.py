@@ -16,13 +16,16 @@ Knobs:
   * `LIGHTGBM_TPU_TRACE=1` — jax.profiler.TraceAnnotation per scope
 """
 
+from .compile_cache import configure_compile_cache
 from .events import (EventLogger, emit_event, get_event_logger,
                      set_event_logger)
+from .hostio import AsyncWriter
 from .registry import MetricsRegistry, global_registry, process_rank
 from .watchdog import (RecompileDetector, sample_device_memory,
                        update_memory_gauges)
 
 __all__ = [
+    "AsyncWriter", "configure_compile_cache",
     "EventLogger", "emit_event", "get_event_logger", "set_event_logger",
     "MetricsRegistry", "global_registry", "process_rank",
     "RecompileDetector", "sample_device_memory", "update_memory_gauges",

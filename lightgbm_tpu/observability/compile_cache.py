@@ -1,0 +1,73 @@
+"""Persistent XLA compilation cache wiring (docs/Performance.md).
+
+Every fresh process pays the full trace+compile cost of the jitted tree
+program before its first iteration — ~60 s for the 255-leaf wave ladder
+at bench scale (PERF_NOTES: "setup gap is compile ... a persistent jax
+compilation cache would remove it for repeat runs").  The
+`compile_cache_dir` parameter turns on JAX's persistent compilation
+cache so a repeat run with the same configuration deserializes the
+compiled executables instead of recompiling.
+
+Hit/miss visibility: JAX reports cache activity through
+`jax.monitoring`; a process-wide listener forwards the events into the
+metrics registry as `compile_cache_hits` / `compile_cache_misses`, so
+they appear in the per-iteration JSONL events and a second run of the
+same config can assert hits > 0 (tests/test_async_io.py).
+
+Only programs whose compile takes >= 1 s are persisted (the ladder
+compile is the multi-second cost being amortized); the micro-jits
+around it recompile cheaply each process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils import log
+from .registry import global_registry
+
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache_hits",
+    "/jax/compilation_cache/cache_misses": "compile_cache_misses",
+}
+
+_configured_dir: Optional[str] = None
+_listener_installed = False
+
+
+def _on_monitoring_event(event: str, **_kwargs) -> None:
+    name = _EVENT_COUNTERS.get(event)
+    if name is not None:
+        global_registry.inc(name)
+
+
+def configure_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at `cache_dir` (created
+    if missing) and install the hit/miss counter listener.  Idempotent;
+    returns False (with a warning) when the runtime refuses — a cache
+    problem must never block training."""
+    global _configured_dir, _listener_installed
+    cache_dir = os.fspath(cache_dir)
+    if _configured_dir == cache_dir:
+        return True
+    try:
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Keep a >=1 s compile-time gate: the target is the multi-second
+        # ladder compile, and persisting the dozens of micro-jits around
+        # it buys nothing — and deserializing many tiny executables
+        # triggers a flaky interpreter-shutdown segfault in this
+        # jaxlib's CPU client (reproduced at gate 0.0, absent at 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        if not _listener_installed:
+            jax.monitoring.register_event_listener(_on_monitoring_event)
+            _listener_installed = True
+        _configured_dir = cache_dir
+        log.debug(f"Persistent compilation cache enabled at {cache_dir}")
+        return True
+    except Exception as e:  # noqa: BLE001 - best effort, never fatal
+        log.warning(f"Could not enable the persistent compilation cache "
+                    f"at {cache_dir}: {e}")
+        return False

@@ -46,14 +46,22 @@ def _json_default(o):
 
 
 class EventLogger:
-    """Append-only JSONL writer for one process of one run."""
+    """Append-only JSONL writer for one process of one run.
 
-    def __init__(self, directory: str, rank=None, rotate_mb: float = 0):
+    With `writer` (an observability.hostio.AsyncWriter) the file append
+    runs on the writer thread: emit() serializes the record on the
+    calling thread (field values and `ts` are captured at emit time)
+    and queues only the finished line, so async and sync runs produce
+    byte-identical logs in the same order (single FIFO worker)."""
+
+    def __init__(self, directory: str, rank=None, rotate_mb: float = 0,
+                 writer=None):
         self.dir = os.fspath(directory)
         os.makedirs(self.dir, exist_ok=True)
         self.rank = process_rank() if rank is None else rank
         self.path = os.path.join(self.dir, f"events-rank{self.rank}.jsonl")
         self.rotate_bytes = int(float(rotate_mb) * (1 << 20))
+        self.writer = writer
         self._fh = open(self.path, "a")
 
     def _rotate(self) -> None:
@@ -71,6 +79,12 @@ class EventLogger:
         rec = {"event": event, "ts": time.time(), "rank": self.rank}
         rec.update(fields)
         line = json.dumps(rec, default=_json_default) + "\n"
+        if self.writer is not None:
+            self.writer.submit(self._append, line)
+        else:
+            self._append(line)
+
+    def _append(self, line: str) -> None:
         if self.rotate_bytes > 0 and self._fh.tell() \
                 and self._fh.tell() + len(line) > self.rotate_bytes:
             try:
@@ -82,6 +96,8 @@ class EventLogger:
 
     def close(self) -> None:
         try:
+            if self.writer is not None:
+                self.writer.flush()
             self._fh.close()
         except OSError:
             pass

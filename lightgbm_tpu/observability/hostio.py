@@ -1,0 +1,100 @@
+"""Bounded single-worker host-I/O thread (docs/Performance.md).
+
+JAX dispatch is asynchronous, so the training loop only goes as fast as
+its slowest HOST work: before this module, every JSONL event append and
+every checkpoint (model-text serialization, npz packing, fsync, rename)
+ran inline on the training thread, stalling dispatch for milliseconds to
+seconds while the accelerator idled.  `AsyncWriter` drains that work on
+ONE worker thread:
+
+* single worker + FIFO queue — writes land in submission order, so the
+  event log and checkpoint rotation behave exactly like the synchronous
+  path (byte-identical files; tests/test_async_io.py pins it);
+* bounded queue — a slow disk backpressures the training loop instead
+  of buffering unboundedly (the reference's equivalent is simply "the
+  CLI blocks on fwrite");
+* failure isolation — a task that raises is logged and counted
+  (`host_io_errors`), never re-raised into training; checkpoint tasks
+  install their own handler so a failed write still increments
+  `checkpoint_failures` and training continues (docs/Reliability.md).
+
+`flush()` blocks until everything queued so far has executed; the engine
+flushes on train end and on error so a crashed run's log is complete up
+to the failure.  After `close()`, submissions run inline (synchronous
+fallback) rather than being dropped.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..utils import log
+from .registry import global_registry
+
+
+class AsyncWriter:
+    """One daemon worker draining host-I/O callables in FIFO order."""
+
+    def __init__(self, max_queue: int = 256):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(int(max_queue), 1))
+        self._thread = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------- worker
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="lgbm-tpu-hostio", daemon=True)
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is None:
+                    return
+                fn, args, kwargs = task
+                fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - I/O must not kill training
+                global_registry.inc("host_io_errors")
+                log.warning(f"Async host write failed: {e}")
+            finally:
+                self._q.task_done()
+
+    # -------------------------------------------------------------- API
+    def submit(self, fn, *args, **kwargs) -> None:
+        """Queue `fn(*args, **kwargs)` for the worker.  Blocks when the
+        queue is full (bounded backpressure).  After close(), runs the
+        task inline so late stragglers are never silently dropped."""
+        if self._closed:
+            try:
+                fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                global_registry.inc("host_io_errors")
+                log.warning(f"Host write failed: {e}")
+            return
+        self._ensure_thread()
+        self._q.put((fn, args, kwargs))
+
+    def flush(self) -> None:
+        """Block until every task submitted so far has executed."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.join()
+
+    def close(self) -> None:
+        """Flush, stop the worker, switch to inline fallback."""
+        self.flush()
+        with self._lock:
+            self._closed = True
+            t = self._thread
+            self._thread = None
+        if t is not None and t.is_alive():
+            self._q.put(None)
+            t.join(timeout=10.0)
+
+    @property
+    def pending(self) -> int:
+        return self._q.qsize()

@@ -1,0 +1,241 @@
+"""Device-resident evaluation metrics (docs/Performance.md).
+
+Before this module, every eval tick pulled the full [K, n] training
+score matrix to host (`np.asarray(self.scores)` in GBDT.eval_train) and
+each host Metric additionally round-tripped the scores through the
+device for `objective.convert_output` — one D2H plus one H2D+D2H *per
+(dataset, metric)*, a per-iteration host sync that de-pipelines JAX's
+async dispatch (the training loop's only other sync is the pipelined
+tree materialization).  Here the built-in metrics are computed in-jit
+over the device score buffers and the whole tick returns ONE packed f32
+vector: [metric values..., gradients_finite, scores_finite] — a single
+small D2H that also feeds the engine's non-finite sentinel (which used
+to sample `scores[:, :256]` to host separately).
+
+The formulas mirror the host classes in metric.py exactly (which mirror
+src/metric/*_metric.hpp); AUC and average_precision use EXACT sorted
+forms (stable sort + tie grouping, like binary_metric.hpp:159), not the
+binned multi-process approximations in metric.py — this evaluator only
+runs when the score buffer is fully addressable.  Values differ from
+the float64 host path by float32 summation rounding only
+(tests/test_device_metrics.py pins parity).
+
+Coverage: a metric set is served on device only when EVERY configured
+metric has a device form and the objective's conversion runs on device
+(run_on_host objectives — per-query host ranking — keep the host path).
+Mixed device/host evaluation would reintroduce the score fetch, so the
+gate is all-or-nothing and the fallback is the unchanged host path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+def device_exact_auc(score, label, weight):
+    """Exact weighted rank-sum AUC on device (ref: binary_metric.hpp:159
+    AUCMetric): stable sort by descending score, equal-score blocks give
+    positives half credit — the same block form as the host class, as
+    segment sums over tie groups.  NaN scores sort last and form
+    singleton groups on both paths (np diff(NaN) and s[i] != s[i+1] both
+    mark a boundary)."""
+    import jax.numpy as jnp
+    order = jnp.argsort(-score, stable=True)
+    lab = label[order] > 0
+    ws = weight[order]
+    s = score[order]
+    pos_w = jnp.where(lab, ws, 0.0)
+    neg_w = jnp.where(lab, 0.0, ws)
+    n = s.shape[0]
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    grp_pos = jnp.zeros(n, jnp.float32).at[gid].add(pos_w)
+    grp_neg = jnp.zeros(n, jnp.float32).at[gid].add(neg_w)
+    pos_above = jnp.cumsum(grp_pos) - grp_pos
+    accum = jnp.sum(grp_neg * (pos_above + 0.5 * grp_pos))
+    tp, tn = jnp.sum(pos_w), jnp.sum(neg_w)
+    return jnp.where((tp == 0) | (tn == 0), 1.0,
+                     accum / jnp.maximum(tp * tn, 1e-30))
+
+
+def device_exact_average_precision(score, label, weight):
+    """Exact weighted average precision on device (ref:
+    binary_metric.hpp AveragePrecisionMetric): descending stable sort,
+    cumulative tp/fp — the host class verbatim in jnp."""
+    import jax.numpy as jnp
+    order = jnp.argsort(-score, stable=True)
+    lab = label[order] > 0
+    ws = weight[order]
+    delta_tp = jnp.where(lab, ws, 0.0)
+    tp = jnp.cumsum(delta_tp)
+    fp = jnp.cumsum(jnp.where(lab, 0.0, ws))
+    prec = tp / jnp.maximum(tp + fp, 1e-20)
+    total_pos = tp[-1]
+    ap = jnp.sum(prec * delta_tp) / jnp.maximum(total_pos, 1e-30)
+    return jnp.where(total_pos == 0, 1.0, ap)
+
+
+def _binary_pointwise(name: str, config):
+    """jnp pointwise loss over CONVERTED single-class scores, or None.
+    Extends metric.device_pointwise_loss with the cross-entropy family
+    (those take the untransformed weight, handled by the caller)."""
+    import jax.numpy as jnp
+    from ..metric import device_pointwise_loss
+    eps15 = 1e-15
+    if name == "cross_entropy":
+        return device_pointwise_loss("xentropy", config)
+    if name == "kullback_leibler":
+        def _kl(p, y):
+            p = jnp.clip(p, eps15, 1 - eps15)
+            y = jnp.clip(y, eps15, 1 - eps15)
+            return (y * jnp.log(y / p)
+                    + (1 - y) * jnp.log((1 - y) / (1 - p)))
+        return _kl
+    return device_pointwise_loss(name, config)
+
+
+# metric name -> reduction kind for the single-class plans
+_KIND_SQRT = "sqrt"        # weighted avg then sqrt (rmse)
+_KIND_AVG = "avg"          # weighted avg (pointwise / sum_weights)
+_KIND_MEAN = "mean"        # plain mean over rows (cross_entropy_lambda)
+_KIND_AUC = "auc"
+_KIND_AP = "average_precision"
+
+
+def build_plans(metrics, config, objective, num_class: int):
+    """[(name, kind, loss_fn_or_None)] when EVERY metric has a device
+    form, else None.  `metrics` are host Metric instances (their .name
+    is the canonical metric name)."""
+    plans: List[Tuple[str, str, object]] = []
+    for m in metrics:
+        name = m.name
+        if num_class > 1:
+            if name in ("multi_logloss", "multi_error"):
+                plans.append((name, name, None))
+                continue
+            return None
+        if name == "auc":
+            plans.append((name, _KIND_AUC, None))
+            continue
+        if name == "average_precision":
+            plans.append((name, _KIND_AP, None))
+            continue
+        if name == "cross_entropy_lambda":
+            plans.append((name, _KIND_MEAN, None))
+            continue
+        fn = _binary_pointwise(name, config)
+        if fn is None:
+            return None
+        plans.append((name, _KIND_SQRT if name == "rmse" else _KIND_AVG,
+                      fn))
+    return plans
+
+
+class DeviceEval:
+    """One-fetch-per-tick metric evaluator bound to a GBDT's training
+    buffers.  `ok` is False when the configuration has no full device
+    form (the caller falls back to the host path); `fetches` counts D2H
+    transfers (tests pin exactly one per eval tick)."""
+
+    def __init__(self, gbdt):
+        self.ok = False
+        self.fetches = 0
+        cfg = gbdt.config
+        obj = gbdt.objective
+        if str(getattr(cfg, "device_eval", "auto")) == "false":
+            return
+        if obj is not None and getattr(obj, "run_on_host", False):
+            return
+        K = gbdt.num_tree_per_iteration
+        plans = build_plans(gbdt.train_metrics, cfg, obj, K)
+        if plans is None:
+            if gbdt.train_metrics:
+                log.debug("device_eval: falling back to host metrics "
+                          "(a configured metric has no device form)")
+            return
+        import jax
+        import jax.numpy as jnp
+
+        md = gbdt.train_data.metadata
+        n_pad = gbdt.n_pad
+        label = np.zeros(n_pad, np.float32)
+        label[:gbdt.num_data] = np.asarray(md.label, np.float32)
+        self._label_dev = gbdt._put_by_row(label)
+        self._weight_dev = None
+        if md.weight is not None:
+            w = np.zeros(n_pad, np.float32)
+            w[:gbdt.num_data] = np.asarray(md.weight, np.float32)
+            self._weight_dev = gbdt._put_by_row(w)
+        self._plans = plans
+        top_k = int(cfg.multi_error_top_k)
+
+        def _tick(scores, label, weight, pad_mask, grad_ok):
+            w = pad_mask if weight is None else weight * pad_mask
+            den = jnp.sum(w)
+            outs = []
+            if K > 1:
+                prob = (obj.convert_output(scores) if obj is not None
+                        else scores)
+                lab_oh = (label[None, :]
+                          == jnp.arange(K, dtype=prob.dtype)[:, None])
+                p_lab = jnp.sum(jnp.where(lab_oh, prob, 0.0), axis=0)
+                for _name, kind, _fn in plans:
+                    if kind == "multi_logloss":
+                        pt = -jnp.log(jnp.clip(p_lab, 1e-15, 1.0))
+                    else:  # multi_error: ties count AGAINST the row
+                        # (ref: multiclass_metric.hpp:142 LossOnPoint)
+                        num_ge = jnp.sum(prob >= p_lab[None, :], axis=0)
+                        pt = (num_ge > top_k).astype(jnp.float32)
+                    outs.append(jnp.sum(pt * w) / den)
+            else:
+                sc = scores[0]
+                conv = obj.convert_output(sc) if obj is not None else sc
+                for _name, kind, fn in plans:
+                    if kind == _KIND_AUC:
+                        # raw scores, like the host class (AUC is
+                        # rank-based; conversion is monotone)
+                        outs.append(device_exact_auc(sc, label, w))
+                    elif kind == _KIND_AP:
+                        outs.append(device_exact_average_precision(
+                            sc, label, w))
+                    elif kind == _KIND_MEAN:
+                        # cross_entropy_lambda: z from the UNmasked
+                        # weight, plain mean (xentropy_metric.hpp)
+                        wz = 1.0 if weight is None else weight
+                        z = jnp.clip(1.0 - jnp.exp(-wz * conv),
+                                     1e-15, 1 - 1e-15)
+                        pt = -(label * jnp.log(z)
+                               + (1.0 - label) * jnp.log(1.0 - z))
+                        outs.append(jnp.sum(pt * pad_mask)
+                                    / jnp.sum(pad_mask))
+                    else:
+                        v = jnp.sum(fn(conv, label) * w) / den
+                        outs.append(jnp.sqrt(v) if kind == _KIND_SQRT
+                                    else v)
+            # the non-finite sentinel flags ride the same packed fetch
+            # (engine._check_finite used to sample scores[:, :256])
+            outs.append(grad_ok.astype(jnp.float32))
+            outs.append(jnp.all(jnp.isfinite(scores)).astype(jnp.float32))
+            return jnp.stack(outs)
+
+        # tpulint: disable-next=donate-argnums -- eval reads the live training score buffer; the boosting loop keeps updating it
+        self._fn = jax.jit(_tick)
+        self._pad_mask = gbdt.pad_mask
+        self._true_flag = jnp.asarray(True)
+        self.ok = True
+
+    def run(self, scores, grad_ok) -> Tuple[List[Tuple[str, float]],
+                                            bool, bool]:
+        """Evaluate one tick: returns ([(metric, value)], grads_finite,
+        scores_finite) with exactly one device->host transfer."""
+        flag = self._true_flag if grad_ok is None else grad_ok
+        vec = np.asarray(self._fn(scores, self._label_dev,
+                                  self._weight_dev, self._pad_mask, flag))
+        self.fetches += 1
+        out = [(name, float(v))
+               for (name, _kind, _fn), v in zip(self._plans, vec)]
+        return out, bool(vec[-2] > 0), bool(vec[-1] > 0)

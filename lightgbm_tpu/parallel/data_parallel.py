@@ -60,7 +60,7 @@ def grow_params_for_mesh(params):
     return params._replace(compact_min=0)
 
 
-def make_sharded_wave_fn(mesh: Mesh):
+def make_sharded_wave_fn(mesh: Mesh, donate: bool = False):
     """Wave engine under explicit jax.shard_map over the data axis — the
     DEFAULT (Pallas) engine's distributed form.
 
@@ -81,12 +81,13 @@ def make_sharded_wave_fn(mesh: Mesh):
 
     @functools.lru_cache(maxsize=None)
     def _build(params, keys):
-        from ..learner.wave import grow_tree_wave
+        from ..learner.wave import grow_tree_wave_impl
         sh_params = params._replace(data_axis=DATA_AXIS)
 
         def inner(binned, grad, hess, row_mask, col_mask, meta, *extras):
-            return grow_tree_wave(binned, grad, hess, row_mask, col_mask,
-                                  meta, sh_params, **dict(zip(keys, extras)))
+            return grow_tree_wave_impl(binned, grad, hess, row_mask,
+                                       col_mask, meta, sh_params,
+                                       **dict(zip(keys, extras)))
 
         ax = DATA_AXIS
         # tree arrays replicated (every shard computes identical
@@ -99,7 +100,10 @@ def make_sharded_wave_fn(mesh: Mesh):
             in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P())
             + (P(),) * len(keys),
             out_specs=(P(), P(ax)),
-            check_vma=False))
+            check_vma=False),
+            # the sharded grad/hess slices die at the grow call, like
+            # the single-device donated entry (learner/wave.py)
+            donate_argnums=(1, 2) if donate else ())
 
     def call(binned, grad, hess, row_mask, col_mask, meta, params,
              cegb_used=None, extra_tag=None, quant_scales=None):

@@ -292,6 +292,7 @@ class LambdarankNDCG(RankingObjective):
                 biases = biases + lr * fd / (jnp.abs(sd) + 0.001)
             return g[None, :], h[None, :], biases
 
+        # tpulint: disable-next=donate-argnums -- gradient maps read the live score buffer; the boosting loop keeps updating it
         jitted = jax.jit(grad_fn, static_argnames=())
         zero1 = jnp.zeros(1, f32)
         zeroi = jnp.zeros(1, jnp.int32)
@@ -460,6 +461,7 @@ class RankXENDCG(RankingObjective):
                 h = h * weight
             return g[None, :], h[None, :]
 
+        # tpulint: disable-next=donate-argnums -- gradient maps read the live score buffer; the boosting loop keeps updating it
         jitted = jax.jit(grad_fn)
         self._xe_iter = 0
 

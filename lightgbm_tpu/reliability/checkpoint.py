@@ -28,6 +28,7 @@ import hashlib
 import io
 import json
 import os
+import zipfile
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -40,13 +41,17 @@ MANIFEST = "manifest.json"
 _FORMAT = 1
 
 # knobs that do not affect the trained model: a checkpoint taken with a
-# different output path or verbosity is still resumable
+# different output path, verbosity, telemetry or serving configuration
+# is still resumable
 _HASH_EXCLUDE = frozenset((
     "verbosity", "verbose", "output_model", "input_model", "output_result",
     "data", "valid", "snapshot_freq", "checkpoint_dir", "checkpoint_freq",
     "checkpoint_keep", "resume", "max_retries", "retry_backoff",
     "nonfinite_check_freq", "machines", "machine_list_filename",
     "local_listen_port", "num_machines", "time_out",
+    "metrics_dir", "metrics_rotate_mb", "profile_dir",
+    "async_host_io", "compile_cache_dir", "device_eval",
+    "device_predict", "device_predict_min_bucket",
 ))
 
 
@@ -79,53 +84,119 @@ class Checkpoint:
             return None
 
 
+def _state_bytes(state: Dict[str, Any]) -> bytes:
+    """Deterministic npz: np.savez stamps each zip member with the
+    current wall clock (2 s DOS resolution), so two runs writing the
+    SAME state produce different bytes — which breaks the async-vs-sync
+    byte-exactness contract (tests/test_async_io.py).  Write the same
+    .npy-in-zip layout with a fixed epoch timestamp instead; np.load
+    reads it unchanged."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_STORED) as zf:
+        for key, value in state.items():
+            member = io.BytesIO()
+            np.lib.format.write_array(member, np.asarray(value),
+                                      allow_pickle=True)
+            info = zipfile.ZipInfo(key + ".npy",
+                                   date_time=(1980, 1, 1, 0, 0, 0))
+            zf.writestr(info, member.getvalue())
+    return buf.getvalue()
+
+
 class CheckpointManager:
-    """Atomic, rotated checkpoints of a training run."""
+    """Atomic, rotated checkpoints of a training run.
+
+    With `writer` (observability.hostio.AsyncWriter) the serialization
+    and file I/O run off the training thread (docs/Performance.md): the
+    training thread only captures the state — the model text plus a
+    device-side score snapshot whose D2H copy is started asynchronously
+    — and the worker fetches, packs and atomically renames.  Failure
+    accounting flows through `on_done` in both modes, so a failed async
+    write still warns/counts and never kills training."""
 
     def __init__(self, directory: str, keep_last: int = 3,
-                 params: Optional[Dict[str, Any]] = None):
+                 params: Optional[Dict[str, Any]] = None, writer=None):
         self.dir = os.fspath(directory)
         self.keep_last = max(int(keep_last), 1)
         self.params_hash = hash_params(params) if params is not None else None
+        self.writer = writer
         os.makedirs(self.dir, exist_ok=True)
 
     # ------------------------------------------------------------- save
     def _name(self, iteration: int, ext: str) -> str:
         return os.path.join(self.dir, f"ckpt_{iteration:07d}.{ext}")
 
-    def save(self, booster, iteration: int) -> Checkpoint:
+    def save(self, booster, iteration: int, on_done=None) -> Checkpoint:
         """Checkpoint `booster` as of `iteration` completed rounds.
-        Raises OSError on write failure (callers decide whether a failed
-        checkpoint is fatal; the training callback warns and continues)."""
+
+        Synchronous mode raises OSError on write failure when no
+        `on_done` is given (direct callers decide); with `on_done(ok,
+        err, ck)` — the training callback's accounting hook — failures
+        are reported through the hook instead.  Async mode returns
+        immediately after capture; the hook fires from the writer
+        thread once the files land (or fail)."""
         from ..utils.timer import global_timer
         with global_timer.scope("Checkpoint::save"):
             it = int(iteration)
-            faults.maybe_ckpt_write_fail(it)
             model_txt = booster.model_to_string(num_iteration=-1)
             state = None
             gbdt = getattr(booster, "_gbdt", None)
             if gbdt is not None and hasattr(gbdt, "capture_train_state"):
-                state = gbdt.capture_train_state()
+                state = gbdt.capture_train_state(
+                    async_copy=self.writer is not None)
+            ck = Checkpoint(it, self._name(it, "txt"),
+                            self._name(it, "npz") if state is not None
+                            else None, self.params_hash)
+            if self.writer is not None:
+                self.writer.submit(self._write_reporting, it, model_txt,
+                                   state, ck, on_done)
+                return ck
+            try:
+                self._write(it, model_txt, state)
+            except OSError as e:
+                if on_done is not None:
+                    on_done(False, e, ck)
+                    return ck
+                raise
+        if on_done is not None:
+            on_done(True, None, ck)
+        return ck
 
-            model_path = self._name(it, "txt")
-            atomic_write_text(model_path, model_txt)
-            state_path = None
-            if state is not None:
-                state_path = self._name(it, "npz")
-                buf = io.BytesIO()
-                np.savez(buf, **state)
-                atomic_write_bytes(state_path, buf.getvalue())
-            manifest = {"format": _FORMAT, "iteration": it,
-                        "model": os.path.basename(model_path),
-                        "state": (os.path.basename(state_path)
-                                  if state_path else None),
-                        "params_hash": self.params_hash}
-            atomic_write_text(os.path.join(self.dir, MANIFEST),
-                              json.dumps(manifest, indent=1))
-            self._rotate()
-            log.debug(
-                f"Checkpoint written at iteration {it} -> {model_path}")
-            return Checkpoint(it, model_path, state_path, self.params_hash)
+    def _write_reporting(self, it, model_txt, state, ck, on_done) -> None:
+        """Worker-side write wrapper: route the outcome through on_done
+        and swallow the failure (reliability contract: a lost checkpoint
+        must never kill a long run)."""
+        try:
+            self._write(it, model_txt, state)
+        except OSError as e:
+            if on_done is not None:
+                on_done(False, e, ck)
+            else:
+                log.warning(f"Async checkpoint write failed at iteration "
+                            f"{it}: {e}; training continues")
+            return
+        if on_done is not None:
+            on_done(True, None, ck)
+
+    def _write(self, it: int, model_txt: str, state) -> None:
+        """Serialize + atomically rename one captured checkpoint (runs
+        on the writer thread in async mode)."""
+        faults.maybe_ckpt_write_fail(it)
+        model_path = self._name(it, "txt")
+        atomic_write_text(model_path, model_txt)
+        state_path = None
+        if state is not None:
+            state_path = self._name(it, "npz")
+            atomic_write_bytes(state_path, _state_bytes(state))
+        manifest = {"format": _FORMAT, "iteration": it,
+                    "model": os.path.basename(model_path),
+                    "state": (os.path.basename(state_path)
+                              if state_path else None),
+                    "params_hash": self.params_hash}
+        atomic_write_text(os.path.join(self.dir, MANIFEST),
+                          json.dumps(manifest, indent=1))
+        self._rotate()
+        log.debug(f"Checkpoint written at iteration {it} -> {model_path}")
 
     def _rotate(self) -> None:
         models = sorted(glob.glob(os.path.join(self.dir, "ckpt_*.txt")))

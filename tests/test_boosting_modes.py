@@ -153,7 +153,10 @@ def test_goss_sample_math():
 
     n = 1000
     rng = np.random.RandomState(0)
-    grad = jnp.asarray(rng.randn(1, n).astype(np.float32))
+    grad_host = rng.randn(1, n).astype(np.float32)
+    # the jit entry DONATES grad/hess (their buffers are dead in the
+    # training loop after sampling, ISSUE 5) — keep host copies
+    grad = jnp.asarray(grad_host)
     hess = jnp.ones((1, n), jnp.float32)
     pad_mask = jnp.ones(n, jnp.float32)
     top_k, other_k = 200, 100
@@ -162,13 +165,13 @@ def test_goss_sample_math():
     kept = int(np.asarray(keep).sum())
     assert abs(kept - (top_k + other_k)) < 60, kept
     # top rows keep their gradient unchanged
-    imp = np.abs(np.asarray(grad[0]))
+    imp = np.abs(grad_host[0])
     top_idx = np.argsort(-imp)[:top_k]
     np.testing.assert_allclose(np.asarray(g2)[0][top_idx],
-                               np.asarray(grad)[0][top_idx], rtol=1e-6)
+                               grad_host[0][top_idx], rtol=1e-6)
     # sampled small-gradient rows are amplified
-    amplified = np.asarray(g2)[0] / np.where(np.asarray(grad)[0] == 0, 1,
-                                             np.asarray(grad)[0])
+    amplified = np.asarray(g2)[0] / np.where(grad_host[0] == 0, 1,
+                                             grad_host[0])
     small_kept = (np.asarray(keep) > 0) & ~np.isin(np.arange(n), top_idx)
     if small_kept.any():
         assert np.all(amplified[small_kept] > 1.0)

@@ -1,0 +1,212 @@
+"""Device-resident eval metrics (ops/metrics.py, ISSUE 5 tentpole).
+
+Three layers:
+
+* function-level parity — device_exact_auc / average_precision vs the
+  host Metric classes over adversarial score vectors (NaN scores, exact
+  ties, weights, degenerate all-pos/all-neg label sets);
+* end-to-end parity — eval histories recorded with device eval (the
+  default) vs the forced host path (`device_eval=false`) agree to f32
+  summation rounding for every covered metric family, including
+  weighted and multiclass runs;
+* the host-boundary contract — an eval tick performs EXACTLY ONE
+  device->host fetch (the packed vector), the host metric path is never
+  entered, and the non-finite sentinel consumes the flags folded into
+  the same fetch.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.callback import record_evaluation
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metric import AUCMetric, AveragePrecisionMetric
+from lightgbm_tpu.ops.metrics import (device_exact_auc,
+                                      device_exact_average_precision)
+
+
+class _Meta:
+    query_boundaries = None
+
+    def __init__(self, label, weight=None):
+        self.label = label
+        self.weight = weight
+
+
+def _host_metric(cls, label, weight, score):
+    m = cls(Config({}))
+    m.init(_Meta(label, weight), len(label))
+    return m.eval(score, None)[0][1]
+
+
+# ------------------------------------------------------- function parity
+@pytest.mark.parametrize("case", ["plain", "weighted", "ties", "nan",
+                                  "all_pos", "all_neg"])
+def test_exact_auc_and_ap_match_host(case):
+    rng = np.random.RandomState(7)
+    n = 500
+    score = rng.randn(n)
+    label = (rng.rand(n) < 0.4).astype(np.float64)
+    weight = None
+    if case == "weighted":
+        weight = (rng.rand(n) * 3).astype(np.float64)
+    elif case == "ties":
+        score = np.round(score, 1)  # heavy exact-tie blocks
+    elif case == "nan":
+        score[rng.rand(n) < 0.1] = np.nan
+    elif case == "all_pos":
+        label[:] = 1.0
+    elif case == "all_neg":
+        label[:] = 0.0
+    s32 = score.astype(np.float32)
+    w32 = (np.ones(n, np.float32) if weight is None
+           else weight.astype(np.float32))
+    dev_auc = float(device_exact_auc(s32, label.astype(np.float32), w32))
+    dev_ap = float(device_exact_average_precision(
+        s32, label.astype(np.float32), w32))
+    # host metrics sort the FLOAT32 scores too, so tie blocks match
+    host_auc = _host_metric(AUCMetric, label, weight,
+                            s32.astype(np.float64))
+    host_ap = _host_metric(AveragePrecisionMetric, label, weight,
+                           s32.astype(np.float64))
+    np.testing.assert_allclose(dev_auc, host_auc, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(dev_ap, host_ap, rtol=2e-5, atol=2e-6)
+
+
+# ----------------------------------------------------- end-to-end parity
+def _histories(params, X, y, rounds=5, weight=None):
+    out = []
+    for device_eval in ("auto", "false"):
+        hist = {}
+        p = dict(params, device_eval=device_eval,
+                 is_provide_training_metric=True, verbosity=-1)
+        lgb.train(p, lgb.Dataset(X, label=y, weight=weight),
+                  num_boost_round=rounds,
+                  callbacks=[record_evaluation(hist)])
+        out.append(hist.get("training", {}))
+    dev, host = out
+    assert set(dev) == set(host) and dev, (dev, host)
+    return dev, host
+
+
+def _assert_close(dev, host, rtol=2e-4):
+    for metric in host:
+        np.testing.assert_allclose(np.asarray(dev[metric]),
+                                   np.asarray(host[metric]),
+                                   rtol=rtol, atol=1e-5, err_msg=metric)
+
+
+def _xy(n=800, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6)
+    y = X[:, 0] + 0.2 * rng.randn(n)
+    return X, y, rng
+
+
+def test_regression_metrics_parity():
+    X, y, rng = _xy()
+    dev, host = _histories(
+        {"objective": "regression", "num_leaves": 7,
+         "metric": ["l2", "rmse", "l1", "quantile", "huber", "fair",
+                    "mape"]}, X, y)
+    _assert_close(dev, host)
+
+
+def test_positive_regression_metrics_parity():
+    X, y, rng = _xy(seed=3)
+    y = np.abs(y) + 0.1
+    dev, host = _histories(
+        {"objective": "poisson", "num_leaves": 7,
+         "metric": ["poisson", "gamma", "gamma_deviance", "tweedie"]},
+        X, y)
+    _assert_close(dev, host)
+
+
+def test_binary_metrics_weighted_parity():
+    X, y, rng = _xy(seed=5)
+    yb = (y > 0).astype(np.float64)
+    w = rng.rand(len(y)) * 2 + 0.25
+    dev, host = _histories(
+        {"objective": "binary", "num_leaves": 7,
+         "metric": ["binary_logloss", "binary_error", "auc",
+                    "average_precision"]}, X, yb, weight=w)
+    _assert_close(dev, host)
+
+
+def test_multiclass_metrics_parity():
+    X, y, rng = _xy(seed=8)
+    yc = rng.randint(0, 3, len(y)).astype(np.float64)
+    dev, host = _histories(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "metric": ["multi_logloss", "multi_error"]}, X, yc)
+    _assert_close(dev, host)
+
+
+def test_xentropy_metrics_parity():
+    X, y, rng = _xy(seed=11)
+    yp = 1.0 / (1.0 + np.exp(-y))          # labels in [0, 1]
+    dev, host = _histories(
+        {"objective": "cross_entropy", "num_leaves": 7,
+         "metric": ["cross_entropy", "kullback_leibler"]}, X, yp)
+    _assert_close(dev, host)
+
+
+def test_uncovered_metric_falls_back_to_host():
+    """auc_mu has no single-process device form: the whole metric set
+    keeps the host path (all-or-nothing gate, no partial fetch)."""
+    X, y, rng = _xy(seed=13)
+    yc = rng.randint(0, 3, len(y)).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "metric": ["multi_logloss", "auc_mu"], "verbosity": -1}
+    b = lgb.train(p, lgb.Dataset(X, label=yc), num_boost_round=2)
+    res = dict(b._gbdt.eval_train())
+    assert "auc_mu" in res and "multi_logloss" in res
+    assert b._gbdt._device_eval is not None
+    assert not b._gbdt._device_eval.ok
+
+
+# ------------------------------------------------- host-boundary contract
+def test_eval_tick_is_one_fetch(monkeypatch):
+    X, y, _ = _xy(seed=17)
+    yb = (y > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "metric": ["binary_logloss", "auc"]}
+    b = lgb.train(p, lgb.Dataset(X, label=yb), num_boost_round=3)
+    g = b._gbdt
+    first = g.eval_train()          # builds the evaluator
+    de = g._device_eval
+    assert de is not None and de.ok
+    assert dict(first)["binary_logloss"] > 0
+    # the host metric path must never run during a device eval tick
+    monkeypatch.setattr(
+        type(g), "_eval",
+        lambda *a, **k: pytest.fail("host metric path entered"))
+    before = de.fetches
+    evals = g.eval_train()
+    assert de.fetches == before + 1          # exactly one packed D2H
+    assert len(evals) == 2
+    # the sentinel flags rode the SAME fetch: consuming them costs no
+    # further sync (run() would bump the counter; the flag fold doesn't)
+    assert g._finite_cache is not None
+    assert g.gradients_finite() and g.scores_finite()
+    assert de.fetches == before + 1
+
+
+def test_sentinel_consumes_device_flags(monkeypatch):
+    """NaN gradients still raise through the packed-flag path."""
+    from lightgbm_tpu.reliability import faults
+    X, y, _ = _xy(seed=19)
+    yb = (y > 0).astype(np.float64)
+    monkeypatch.setenv("LGBM_TPU_FAULT", "nan_grad@2")
+    faults.reload()
+    try:
+        with pytest.raises(lgb.LightGBMError, match="[Nn]on-finite"):
+            lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbosity": -1, "nonfinite_check_freq": 1,
+                       "metric": "binary_logloss",
+                       "is_provide_training_metric": True},
+                      lgb.Dataset(X, label=yb), num_boost_round=5)
+    finally:
+        monkeypatch.delenv("LGBM_TPU_FAULT")
+        faults.reload()

@@ -179,21 +179,46 @@ def test_loaded_model_round_trip(binary_cat):
     Xt = _test_points()
     g = loaded._gbdt
     g.config.device_predict = "true"
-    dp = g._device_predictor(Xt, 0, -1)
-    assert dp is not None
-    assert np.array_equal(dp.predict_leaf(Xt),
+    hit = g._device_predictor(Xt, 0, -1)
+    assert hit is not None
+    dp, Xt32 = hit
+    assert np.array_equal(dp.predict_leaf(Xt32),
                           _tree_leaves(g.models_, np.asarray(Xt, np.float64)))
 
 
 # ------------------------------------------------------------------ routing gate
-def test_float64_falls_back_to_host(binary_cat):
+def test_float64_lossless_serves_device(binary_cat):
+    """f32-round-trippable float64 (integral features, f32-sourced
+    pipelines) is downcast and served by the device path — the ROADMAP'd
+    Serving follow-up; routing stays bit-identical because the downcast
+    is exact."""
+    bst, X = binary_cat
+    g = bst._gbdt
+    g.config.device_predict = "true"
+    try:
+        X64 = np.asarray(_test_points(), np.float64)  # f32-sourced
+        hit = g._device_predictor(X64, 0, -1)
+        assert hit is not None
+        assert hit[1].dtype == np.float32
+        # end to end: lossless float64 equals the pure host reference
+        pred64 = bst.predict(X64)
+        g.config.device_predict = "false"
+        np.testing.assert_allclose(pred64, bst.predict(X64),
+                                   rtol=RTOL, atol=ATOL)
+    finally:
+        g.config.device_predict = "false"
+
+
+def test_float64_lossy_falls_back_to_host(binary_cat):
+    """float64 values that do NOT survive the f32 round trip keep the
+    host path (the bit-exact routing argument needs float32 inputs)."""
     bst, X = binary_cat
     g = bst._gbdt
     g.config.device_predict = "true"
     try:
         X64 = np.asarray(_test_points(), np.float64)
+        X64[0, 1] = 0.1          # not representable in float32
         assert g._device_predictor(X64, 0, -1) is None
-        # end to end: float64 predict equals the pure host reference
         pred64 = bst.predict(X64)
         g.config.device_predict = "false"
         np.testing.assert_allclose(pred64, bst.predict(X64), rtol=0, atol=0)

@@ -120,9 +120,11 @@ def test_event_log_checkpoint_and_fault_events(tmp_path, monkeypatch):
     assert len(failed) == 1 and failed[0]["iteration"] == 5
     ok = [e for e in events if e["event"] == "checkpoint"]
     assert [e["iteration"] for e in ok] == [10]
-    # counters in the final iteration event reflect both outcomes (the
-    # registry is process-wide, so compare against the pre-run values)
-    last = [e for e in events if e["event"] == "iteration"][-1]
+    # counters must reflect both outcomes.  Per-iteration events can lag
+    # the ASYNC checkpoint writer (the final write lands after the last
+    # iteration event snapshots the registry), so the settled numbers
+    # live in train_end's post-flush snapshot (ISSUE 5).
+    last = [e for e in events if e["event"] == "train_end"][-1]
     assert last["counters"].get("checkpoint_failures", 0) == fails0 + 1
     assert last["counters"].get("checkpoint_writes", 0) == writes0 + 1
 

@@ -55,8 +55,8 @@ def _rules_of(report):
 def test_registry_has_all_rules():
     from tools.tpulint import rules as _  # noqa: F401
     assert {"no-host-sync-in-jit", "no-tracer-branch", "explicit-dtype",
-            "collective-discipline", "no-bare-print",
-            "config-doc-sync", "no-device-put-in-loop"} <= set(RULES)
+            "collective-discipline", "no-bare-print", "config-doc-sync",
+            "no-device-put-in-loop", "donate-argnums"} <= set(RULES)
 
 
 def test_cli_json_format_and_exit_codes(tmp_path):
@@ -197,6 +197,55 @@ def test_no_device_put_in_loop_suppression(tmp_path):
                 x = jax.device_put(b)  # tpulint: disable=no-device-put-in-loop -- fixture
             return x
         """}, rules=["no-device-put-in-loop"])
+    assert not rep.active
+    assert len(rep.suppressed) == 1
+
+
+# --------------------------------------------------------- donate-argnums
+def test_donate_argnums_positives_and_negatives(tmp_path):
+    rep = _lint(tmp_path, {"boosting/u.py": """
+        import functools
+        import jax
+
+        @jax.jit
+        def bad_update(scores, delta):              # flagged (line 5)
+            return scores + delta
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def bad_grow(binned, grad, hess, k):        # flagged (line 9)
+            return grad * hess
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def ok_grow(binned, grad, hess):            # covered
+            return grad * hess
+
+        @jax.jit
+        def ok_names(sc, g, h):                     # not canonical names
+            return sc + g * h
+
+        def upd(scores, delta):
+            return scores + delta
+        bad_assign = jax.jit(upd)                   # flagged (line 23)
+        ok_assign = jax.jit(upd, donate_argnums=(0,))
+        ok_named = jax.jit(upd, donate_argnames=("scores",))
+        _gate = (0,)
+        ok_gated = jax.jit(upd, donate_argnums=_gate)   # config-gated
+        """}, rules=["donate-argnums"])
+    assert _rules_of(rep) == [
+        ("boosting/u.py", 5, "donate-argnums"),
+        ("boosting/u.py", 9, "donate-argnums"),
+        ("boosting/u.py", 23, "donate-argnums")]
+
+
+def test_donate_argnums_suppression(tmp_path):
+    rep = _lint(tmp_path, {"boosting/v.py": """
+        import jax
+
+        def eval_fn(scores):
+            return scores.sum()
+        # tpulint: disable-next=donate-argnums -- read-only eval, caller keeps the buffer
+        jitted = jax.jit(eval_fn)
+        """}, rules=["donate-argnums"])
     assert not rep.active
     assert len(rep.suppressed) == 1
 
@@ -404,12 +453,13 @@ def test_package_finds_jit_roots():
     from tools.tpulint.core import LintContext
     funcs = build_reachable(PackageIndex(LintContext(PACKAGE)))
     names = {f.qualname for f in funcs}
-    assert {"grow_tree", "grow_tree_wave", "find_best_split",
+    assert {"grow_tree_impl", "grow_tree_wave_impl", "find_best_split",
             "build_histogram"} <= names
     roots = {f.qualname for f in funcs if f.jit_root}
-    assert {"grow_tree", "grow_tree_wave"} <= roots
+    # the impls are rooted through BOTH jit entries (plain and donated)
+    assert {"grow_tree_impl", "grow_tree_wave_impl"} <= roots
     # static_argnames honored on the engine entry points
     by_name = {f.qualname: f for f in funcs}
-    assert "params" in by_name["grow_tree"].static_params
-    assert "params" not in by_name["grow_tree"].tainted_params
-    assert "binned" in by_name["grow_tree"].tainted_params
+    assert "params" in by_name["grow_tree_impl"].static_params
+    assert "params" not in by_name["grow_tree_impl"].tainted_params
+    assert "binned" in by_name["grow_tree_impl"].tainted_params

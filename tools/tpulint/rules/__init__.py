@@ -10,5 +10,6 @@ from . import bare_print      # noqa: F401
 from . import collectives     # noqa: F401
 from . import config_doc      # noqa: F401
 from . import device_put      # noqa: F401
+from . import donate          # noqa: F401
 from . import dtype           # noqa: F401
 from . import host_sync       # noqa: F401

@@ -1,0 +1,159 @@
+"""donate-argnums: jitted entries taking score/gradient buffers must
+donate them.
+
+The training loop's big per-iteration arrays — the [K, n] score buffer
+and the gradient/hessian maps — are rewritten every iteration.  A jitted
+update that takes one of them WITHOUT `donate_argnums`/`donate_argnames`
+forces XLA to allocate a fresh output buffer while the input stays live:
+at 10M rows that is an extra [K, n_pad] f32 allocation per tree, HBM
+the histogram stack could have used, plus a copy the aliasing pass would
+have elided (jax docs: buffer donation).  This is the lint-time form of
+the ROADMAP'd "score buffers should be donated in jit" follow-up.
+
+The rule is NAME-based: a function wrapped in `jax.jit` (decorator,
+`functools.partial(jax.jit, ...)` decorator, or the assignment form
+`f = jax.jit(g, ...)` where `g`/a lambda is visible in the module) whose
+parameters include one of `scores`/`grad`/`hess`/`gradients`/`hessians`
+must cover every such parameter with `donate_argnums` (positional
+index) or `donate_argnames`.  A donate keyword whose value is not a
+literal tuple (a config-gated expression like
+`donate_argnums=_donate0`) counts as covering — the donation decision
+is then runtime configuration, which is exactly the sanctioned escape
+hatch.  Genuinely read-only consumers (eval reductions, sentinel flag
+folds, gradient maps whose caller keeps the scores) suppress with a
+justification, keeping the audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..core import Finding, LintContext, Rule, register
+
+# canonical buffer parameter names the training loop uses
+DONATABLE = {"scores", "grad", "hess", "gradients", "hessians"}
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    return names
+
+
+def _donate_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str],
+                                                   bool]]:
+    """(indices, names, is_literal) from a jit call's donate keywords;
+    None when no donate keyword is present."""
+    found = False
+    idxs: Set[int] = set()
+    names: Set[str] = set()
+    literal = True
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        found = True
+        consts = [v for v in ast.walk(kw.value)
+                  if isinstance(v, ast.Constant)]
+        if isinstance(kw.value, (ast.Tuple, ast.List, ast.Constant)):
+            for v in consts:
+                if isinstance(v.value, int) and not isinstance(v.value,
+                                                               bool):
+                    idxs.add(v.value)
+                elif isinstance(v.value, str):
+                    names.add(v.value)
+        else:
+            # non-literal (config-gated) donate expression: trust it
+            literal = False
+    return (idxs, names, literal) if found else None
+
+
+@register
+class DonateArgnums(Rule):
+    name = "donate-argnums"
+    description = ("jitted entries taking score/gradient buffers must "
+                   "donate them (donate_argnums) so XLA reuses the HBM "
+                   "instead of allocating a fresh output buffer")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        from ..callgraph import ModuleInfo
+        out: List[Finding] = []
+        for pf in ctx.files:
+            if pf.tree is None:
+                continue
+            mi = ModuleInfo(pf, ctx.package_name)
+            for node in ast.walk(pf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        jit_call = self._as_jit_call(mi, dec)
+                        if jit_call is not None:
+                            out.extend(self._check_entry(
+                                pf, node, jit_call[0], jit_call[1]))
+                elif isinstance(node, ast.Call) \
+                        and self._is_jit_name(mi, node.func) and node.args:
+                    target = node.args[0]
+                    fn = None
+                    if isinstance(target, ast.Lambda):
+                        fn = target
+                    elif isinstance(target, ast.Name):
+                        fn = self._find_def(pf.tree, target.id)
+                    if fn is not None:
+                        out.extend(self._check_entry(pf, fn, node,
+                                                     node.lineno))
+        return out
+
+    # ---- helpers -----------------------------------------------------
+    def _is_jit_name(self, mi, expr: ast.AST) -> bool:
+        return mi.dotted_of(expr) in ("jax.jit", "jit")
+
+    def _as_jit_call(self, mi, dec: ast.AST):
+        """(call_node, report_line) when `dec` is a jit decorator that
+        can carry donate keywords; None otherwise.  A bare `@jax.jit`
+        is a Name/Attribute (no keywords possible)."""
+        if isinstance(dec, ast.Call):
+            if self._is_jit_name(mi, dec.func):
+                return dec, dec.lineno
+            dotted = mi.dotted_of(dec.func)
+            if dotted in ("functools.partial", "partial") and dec.args \
+                    and self._is_jit_name(mi, dec.args[0]):
+                return dec, dec.lineno
+        elif self._is_jit_name(mi, dec):
+            # bare @jax.jit: treat as a donate-less jit call
+            return ast.Call(func=dec, args=[], keywords=[]), dec.lineno
+        return None
+
+    def _find_def(self, tree: ast.AST, name: str) -> Optional[ast.AST]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                return node
+        return None
+
+    def _check_entry(self, pf, fn: ast.AST, call: ast.Call,
+                     line: int) -> List[Finding]:
+        params = _param_names(fn)
+        hits = [(i, p) for i, p in enumerate(params) if p in DONATABLE]
+        if not hits:
+            return []
+        spec = _donate_spec(call)
+        missing = []
+        for i, p in hits:
+            if spec is None:
+                missing.append(p)
+                continue
+            idxs, names, literal = spec
+            if not literal or i in idxs or p in names:
+                continue
+            missing.append(p)
+        if not missing:
+            return []
+        return [Finding(
+            rule=self.name, path=pf.rel, line=line, col=0,
+            message=f"jitted entry takes buffer parameter(s) "
+                    f"{', '.join(repr(m) for m in missing)} without "
+                    "donating them — add donate_argnums/donate_argnames "
+                    "(XLA then reuses the input HBM for the output) or "
+                    "suppress with a justification if the caller keeps "
+                    "the buffer")]
