@@ -637,6 +637,17 @@ class GBDT:
         # call in every configuration except linear trees, whose leaf
         # fitting re-reads them afterwards
         donate_grow = (config.tpu_donate_buffers and not config.linear_tree)
+        if donate_grow and self.mesh is not None:
+            # Donating the sharded grad/hess slices under the mesh is the
+            # donation x SPMD interaction implicated in the MULTICHIP_r05
+            # timeout: XLA cannot alias the row-sharded f32 inputs into
+            # any output of the grow program (different dtype/sharding),
+            # so donation buys nothing and destabilizes the multi-device
+            # compile.  tests/test_multichip_smoke.py guards this matrix.
+            log.warning("tpu_donate_buffers: grow-buffer donation is "
+                        "disabled under a device mesh (sharded inputs "
+                        "cannot alias the grow outputs)")
+            donate_grow = False
         if strategy == "wave" and (self.mesh is not None
                                    and self._mesh_axis == 1
                                    and self.grow_params.voting is None):
@@ -1209,6 +1220,18 @@ class GBDT:
                                  * K + k))
                 else:
                     gq, hq, qscales = g_k, h_k, None
+                # the float g_k/h_k slices are consumed after growth only
+                # by linear-leaf fitting (donation off) and quantized leaf
+                # renewal (gq/hq are then distinct buffers); snapshot the
+                # tuple BEFORE the grow call — when quantization is off,
+                # gq/hq ALIAS g_k/h_k and the donated grow entries delete
+                # their argument buffers (tpulint donated-buffer-reuse)
+                float_grads = ((g_k, h_k)
+                               if (self.config.linear_tree
+                                   or (self.use_quant
+                                       and self.config
+                                       .quant_train_renew_leaf))
+                               else None)
                 with global_timer.scope("GBDT::grow_tree"):
                     grow_kw = ({"cegb_used": self._cegb_used}
                                if self._cegb_used is not None else {})
@@ -1241,7 +1264,7 @@ class GBDT:
                 with global_timer.scope("GBDT::finalize_tree"):
                     tree = self._finalize_tree(arrays, leaf_id, k,
                                                init_scores[k],
-                                               float_grads=(g_k, h_k))
+                                               float_grads=float_grads)
                 _metrics.inc("trees_grown")
             if tree is None:
                 if len(self.models_) < K:

@@ -1,0 +1,24 @@
+"""shard_map across jax versions.
+
+`jax.shard_map` is the stable entry point on current jax; older
+releases (<= 0.4.x, the CPU container's pin) only ship
+`jax.experimental.shard_map.shard_map`, whose replication-checker
+keyword is `check_rep` instead of `check_vma`.  Every shard_map in the
+package goes through this wrapper so the sharded engines run on both
+runtimes — the virtual 8-device CPU mesh the tests use and the real
+TPU driver.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

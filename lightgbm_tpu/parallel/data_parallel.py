@@ -29,6 +29,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._shard_compat import shard_map
+
 
 DATA_AXIS = "data"
 
@@ -95,15 +97,28 @@ def make_sharded_wave_fn(mesh: Mesh, donate: bool = False):
         # check_vma off: replication of the tree outputs is by
         # construction (all inputs to the bookkeeping are psum results),
         # which the static checker cannot see through the Pallas calls.
-        return jax.jit(jax.shard_map(
+        mapped = shard_map(
             inner, mesh=mesh,
             in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P())
             + (P(),) * len(keys),
             out_specs=(P(), P(ax)),
-            check_vma=False),
-            # the sharded grad/hess slices die at the grow call, like
-            # the single-device donated entry (learner/wave.py)
-            donate_argnums=(1, 2) if donate else ())
+            check_vma=False)
+        if not donate:
+            return jax.jit(mapped)
+        # donated buffers entering a shard_map'd entry must carry
+        # EXPLICIT shardings: leaving XLA to infer the donated layout
+        # from the arguments is the donation x SPMD interaction the
+        # MULTICHIP_r05 round implicated (tpulint spmd-axis-discipline
+        # enforces this statically).  The sharded grad/hess slices die
+        # at the grow call, like the single-device donated entry
+        # (learner/wave.py).
+        row = NamedSharding(mesh, P(ax))
+        repl = NamedSharding(mesh, P())
+        return jax.jit(
+            mapped,
+            in_shardings=(NamedSharding(mesh, P(None, ax)), row, row,
+                          row, repl, repl) + (repl,) * len(keys),
+            donate_argnums=(1, 2))
 
     def call(binned, grad, hess, row_mask, col_mask, meta, params,
              cegb_used=None, extra_tag=None, quant_scales=None):

@@ -37,8 +37,9 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ._shard_compat import shard_map
 
 from ..ops.histogram import build_histogram
 from ..ops.split import K_MIN_SCORE, SplitParams, find_best_split

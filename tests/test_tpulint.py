@@ -56,7 +56,10 @@ def test_registry_has_all_rules():
     from tools.tpulint import rules as _  # noqa: F401
     assert {"no-host-sync-in-jit", "no-tracer-branch", "explicit-dtype",
             "collective-discipline", "no-bare-print", "config-doc-sync",
-            "no-device-put-in-loop", "donate-argnums"} <= set(RULES)
+            "no-device-put-in-loop", "donate-argnums",
+            # v2 (ISSUE 6): interprocedural rule families
+            "no-dynamic-shape-in-jit", "donated-buffer-reuse",
+            "spmd-axis-discipline", "donated-sharding"} <= set(RULES)
 
 
 def test_cli_json_format_and_exit_codes(tmp_path):
@@ -463,3 +466,505 @@ def test_package_finds_jit_roots():
     assert "params" in by_name["grow_tree_impl"].static_params
     assert "params" not in by_name["grow_tree_impl"].tainted_params
     assert "binned" in by_name["grow_tree_impl"].tainted_params
+
+
+# ===================================================== v2: call graph
+def test_taint_flows_through_method_call(tmp_path):
+    """Acceptance: jit-taint must flow through a self.method() call —
+    the class-hierarchy resolution of callgraph v2."""
+    rep = _lint(tmp_path, {"learner/eng.py": """
+        import jax
+
+        class Engine:
+            def helper(self, v, k):
+                bad = float(v)          # BAD: v tainted via self.helper
+                ok = int(k)             # ok: literal at the call site
+                return bad, ok
+
+            @jax.jit
+            def run(self, x):
+                return self.helper(x * 2, 3)
+
+            def host(self, y):
+                return float(y)         # ok: not jit-reachable
+        """}, rules=["no-host-sync-in-jit"])
+    assert _rules_of(rep) == [("learner/eng.py", 6, "no-host-sync-in-jit")]
+
+
+def test_taint_flows_through_inherited_method(tmp_path):
+    rep = _lint(tmp_path, {"learner/eng.py": """
+        import jax
+
+        class Base:
+            def helper(self, v):
+                return v.item()          # BAD: reached from Child.run
+
+        class Child(Base):
+            @jax.jit
+            def run(self, x):
+                return self.helper(x)
+        """}, rules=["no-host-sync-in-jit"])
+    assert _rules_of(rep) == [("learner/eng.py", 6, "no-host-sync-in-jit")]
+
+
+def test_taint_flows_through_dict_dispatch(tmp_path):
+    """Acceptance: jit-taint must flow through a dict-dispatched entry
+    (the jit-entry-table shape the boosting loop uses)."""
+    rep = _lint(tmp_path, {"ops/table.py": """
+        import jax
+
+        def impl_a(x):
+            return float(x)             # BAD: dispatched with traced x
+        def impl_b(x):
+            return x * 2                # ok
+        TABLE = {"a": impl_a, "b": impl_b}
+
+        @jax.jit
+        def entry(x):
+            return TABLE["a"](x)
+        """}, rules=["no-host-sync-in-jit"])
+    assert _rules_of(rep) == [("ops/table.py", 5, "no-host-sync-in-jit")]
+
+
+def test_taint_flows_through_function_argument(tmp_path):
+    """A function reference passed as an argument is called inside the
+    callee — the higher-order edge of callgraph v2."""
+    rep = _lint(tmp_path, {"ops/hof.py": """
+        import jax
+
+        def apply(fn, v):
+            return fn(v)
+
+        def helper(v):
+            return bool(v)              # BAD: bound via apply(helper, x)
+
+        @jax.jit
+        def entry(x):
+            return apply(helper, x)
+        """}, rules=["no-host-sync-in-jit"])
+    assert _rules_of(rep) == [("ops/hof.py", 8, "no-host-sync-in-jit")]
+
+
+def test_taint_flows_through_attr_binding_and_reexport(tmp_path):
+    """self._fn = jax.jit(work) where `work` arrives through a package
+    __init__ re-export: the binding + import-chain resolution."""
+    rep = _lint(tmp_path, {
+        "learner/impl.py": """
+        def work(v):
+            return v.tolist()           # BAD: jit-rooted via the attr
+        """,
+        "learner/__init__.py": """
+        from .impl import work
+        """,
+        "boosting/g.py": """
+        import jax
+        from ..learner import work
+
+        class G:
+            def __init__(self):
+                self._fn = jax.jit(work)
+        """}, rules=["no-host-sync-in-jit"])
+    assert _rules_of(rep) == [("learner/impl.py", 3,
+                               "no-host-sync-in-jit")]
+
+
+def test_tracer_branch_through_method(tmp_path):
+    rep = _lint(tmp_path, {"learner/m.py": """
+        import jax
+
+        class T:
+            def decide(self, v):
+                if v > 0:               # BAD: tracer branch via method
+                    return 1
+                return 0
+
+            @jax.jit
+            def run(self, x):
+                return self.decide(x)
+        """}, rules=["no-tracer-branch"])
+    assert _rules_of(rep) == [("learner/m.py", 6, "no-tracer-branch")]
+
+
+# ======================================== v2: no-dynamic-shape-in-jit
+def test_dynamic_shape_positives(tmp_path):
+    rep = _lint(tmp_path, {"learner/d.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def entry(x, idx):
+            nz = jnp.nonzero(x)             # BAD: no size=
+            u = jnp.unique(x)               # BAD: no size=
+            w = jnp.where(x > 0)            # BAD: 1-arg where
+            m = x[x > 0]                    # BAD: boolean mask index
+            r = jnp.repeat(x, idx)          # BAD: traced repeats
+            z = jnp.zeros(idx)              # BAD: traced shape arg
+            return nz, u, w, m, r, z
+        """}, rules=["no-dynamic-shape-in-jit"])
+    lines = [ln for _, ln, _ in _rules_of(rep)]
+    assert lines == [7, 8, 9, 10, 11, 12], _rules_of(rep)
+
+
+def test_dynamic_shape_negatives(tmp_path):
+    rep = _lint(tmp_path, {"learner/ok.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def entry(x):
+            nz = jnp.nonzero(x, size=8)         # size given
+            w3 = jnp.where(x > 0, x, 0.0)       # 3-arg select
+            fl = x.reshape(-1)                  # static geometry
+            z = jnp.zeros(x.shape[0], jnp.float32)  # shape is static
+            g = x[jnp.argmax(x)]                # int index, not a mask
+            r = jnp.repeat(x, 3)                # constant repeats
+            return nz, w3, fl, z, g, r
+
+        def host(mask, vals):
+            return vals[mask > 0]               # ok: not jit-reachable
+        """}, rules=["no-dynamic-shape-in-jit"])
+    assert _rules_of(rep) == [], _rules_of(rep)
+
+
+def test_dynamic_shape_bool_name_is_scoped(tmp_path):
+    """A bool-mask name in one nested function must not poison an
+    integer index of the same name in a sibling scope (the grow.py
+    `pos` false positive the scope-keyed _BoolNames fixes)."""
+    rep = _lint(tmp_path, {"learner/s.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def entry(x):
+            def a(v):
+                pos = v > 0                       # bool here
+                return jnp.where(pos, v, 0.0)
+            def b(v):
+                pos = jnp.where(v > 0, 1, 0).cumsum() - 1
+                return v.at[pos].set(v)           # int index: clean
+            return a(x) + b(x)
+        """}, rules=["no-dynamic-shape-in-jit"])
+    assert _rules_of(rep) == [], _rules_of(rep)
+
+
+def test_dynamic_shape_static_param_is_clean(tmp_path):
+    rep = _lint(tmp_path, {"learner/st.py": """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def entry(x, n):
+            return jnp.zeros(n, jnp.float32) + x  # static shape param
+        """}, rules=["no-dynamic-shape-in-jit"])
+    assert _rules_of(rep) == []
+
+
+# ========================================== v2: donated-buffer-reuse
+def test_donated_reuse_read_after_donate(tmp_path):
+    rep = _lint(tmp_path, {"boosting/u.py": """
+        import jax
+
+        def upd(scores, delta):
+            return scores + delta
+        donated = jax.jit(upd, donate_argnums=(0,))
+
+        def bad_loop(scores, deltas):
+            out = donated(scores, deltas)
+            return scores.sum() + out.sum()      # BAD: scores donated
+
+        def ok_rebind(scores, deltas):
+            scores = donated(scores, deltas)     # donate-and-rebind
+            return scores.sum()
+        """}, rules=["donated-buffer-reuse"])
+    assert _rules_of(rep) == [("boosting/u.py", 10,
+                               "donated-buffer-reuse")]
+
+
+def test_donated_reuse_alias_tracking(tmp_path):
+    """gq, hq = g_k, h_k then donating gq consumes g_k too — the exact
+    gbdt.py float_grads hazard the sweep fixed."""
+    rep = _lint(tmp_path, {"boosting/a.py": """
+        import jax
+
+        def grow(binned, grad, hess):
+            return binned
+        grow_donated = jax.jit(grow, donate_argnums=(1, 2))
+
+        def train(binned, g_k, h_k):
+            gq, hq = g_k, h_k
+            out = grow_donated(binned, gq, hq)
+            return out, (g_k, h_k)               # BAD x2: aliases died
+
+        def train_ok(binned, g_k, h_k):
+            snap = (g_k, h_k)                    # read BEFORE donation
+            gq, hq = g_k, h_k
+            out = grow_donated(binned, gq, hq)
+            return out, snap
+        """}, rules=["donated-buffer-reuse"])
+    assert [(p, ln) for p, ln, _ in _rules_of(rep)] == [
+        ("boosting/a.py", 11), ("boosting/a.py", 11)]
+
+
+def test_donated_reuse_self_attr_entry(tmp_path):
+    """Donated entries bound to self attributes — including the
+    config-gated spec and a wrapper rebind — are resolved at call
+    sites; the idiomatic self.scores = self._fn(self.scores) is clean."""
+    rep = _lint(tmp_path, {"boosting/c.py": """
+        import jax
+
+        class Wrap:
+            def __init__(self, fn, tag):
+                self.fn = fn
+
+        class G:
+            def __init__(self, cfg):
+                def upd(scores, v):
+                    return scores + v
+                _donate0 = (0,) if cfg else ()
+                self._fn = jax.jit(upd, donate_argnums=_donate0)
+                self._fn = Wrap(self._fn, "tag")
+
+            def ok(self, v):
+                self.scores = self._fn(self.scores, v)
+                return self.scores
+
+            def bad(self, v):
+                out = self._fn(self.scores, v)   # donates self.scores
+                return self.scores + out          # BAD
+        """}, rules=["donated-buffer-reuse"])
+    assert _rules_of(rep) == [("boosting/c.py", 22,
+                               "donated-buffer-reuse")]
+
+
+def test_donated_reuse_branch_merge_and_suppression(tmp_path):
+    rep = _lint(tmp_path, {"boosting/b.py": """
+        import jax
+
+        def upd(scores, v):
+            return scores + v
+        donated = jax.jit(upd, donate_argnames=("scores",))
+
+        def branchy(scores, v, flag):
+            if flag:
+                out = donated(scores, v)
+            else:
+                out = scores * 2
+            return scores + out                  # BAD: either branch
+
+        def suppressed(scores, v):
+            out = donated(scores, v)
+            # tpulint: disable-next=donated-buffer-reuse -- fixture: donation is off in this config
+            return scores + out
+        """}, rules=["donated-buffer-reuse"])
+    assert _rules_of(rep) == [("boosting/b.py", 13,
+                               "donated-buffer-reuse")]
+    assert len(rep.suppressed) == 1
+
+
+# ========================================= v2: spmd-axis-discipline
+_SPMD_BASE = {
+    "parallel/mesh.py": """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    DATA_AXIS = "data"
+
+    def make_mesh(devices):
+        return Mesh(np.array(devices), (DATA_AXIS,))
+    """,
+}
+
+
+def test_spmd_axis_name_mismatch(tmp_path):
+    files = dict(_SPMD_BASE)
+    files["parallel/dp.py"] = """
+        import jax
+        from .compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def reduce_local(x):
+            return jax.lax.psum(x, "nodes")      # BAD: undeclared axis
+
+        def run(mesh, x):
+            return shard_map(reduce_local, mesh=mesh,
+                             in_specs=(P("data"),), out_specs=P())(x)
+        """
+    files["parallel/compat.py"] = """
+        def shard_map(f, mesh, in_specs, out_specs):
+            return f
+        """
+    rep = _lint(tmp_path, files, rules=["spmd-axis-discipline"])
+    assert [(p, ln) for p, ln, _ in _rules_of(rep)] == [
+        ("parallel/dp.py", 7)]
+    assert "nodes" in rep.active[0].message
+
+
+def test_spmd_partition_spec_axis_checked(tmp_path):
+    files = dict(_SPMD_BASE)
+    files["parallel/sp.py"] = """
+        from jax.sharding import PartitionSpec as P
+
+        GOOD = P(None, "data")
+        BAD = P("rows")                          # BAD: undeclared axis
+        """
+    rep = _lint(tmp_path, files, rules=["spmd-axis-discipline"])
+    assert [(p, ln) for p, ln, _ in _rules_of(rep)] == [
+        ("parallel/sp.py", 5)]
+
+
+def test_spmd_collective_needs_shard_map(tmp_path):
+    files = dict(_SPMD_BASE)
+    files["parallel/loose.py"] = """
+        import jax
+
+        def stray(x):
+            return jax.lax.psum(x, "data")       # BAD: no shard_map
+        """
+    rep = _lint(tmp_path, files, rules=["spmd-axis-discipline"])
+    assert [(p, ln) for p, ln, _ in _rules_of(rep)] == [
+        ("parallel/loose.py", 5)]
+
+
+def test_spmd_collective_reachable_from_shard_map_is_clean(tmp_path):
+    """The wave-engine shape: the psum lives two calls away from the
+    shard_map wrapper, connected only through the v2 call graph."""
+    files = dict(_SPMD_BASE)
+    files["learner/engine.py"] = """
+        import jax
+
+        def _psum(x, axis):
+            return jax.lax.psum(x, "data")       # ok: reachable
+
+        def grow_impl(x):
+            return _psum(x, "data")
+        """
+    files["parallel/dp.py"] = """
+        from ..learner.engine import grow_impl
+        from .compat import shard_map
+
+        def make_fn(mesh):
+            def inner(x):
+                return grow_impl(x)
+            return shard_map(inner, mesh=mesh, in_specs=(),
+                             out_specs=())
+        """
+    files["parallel/compat.py"] = """
+        def shard_map(f, mesh, in_specs, out_specs):
+            return f
+        """
+    rep = _lint(tmp_path, files, rules=["spmd-axis-discipline"])
+    assert _rules_of(rep) == [], _rules_of(rep)
+
+
+# ============================================== v2: donated-sharding
+def test_donated_sharding_positive_and_negative(tmp_path):
+    rep = _lint(tmp_path, {
+        "parallel/compat.py": """
+        def shard_map(f, mesh, in_specs, out_specs):
+            return f
+        """,
+        "parallel/d.py": """
+        import jax
+        from .compat import shard_map
+
+        def build(mesh, inner, specs, donate):
+            mapped = shard_map(inner, mesh=mesh, in_specs=specs,
+                               out_specs=specs)
+            bad = jax.jit(mapped, donate_argnums=(1, 2))      # BAD
+            bad2 = jax.jit(
+                shard_map(inner, mesh=mesh, in_specs=specs,
+                          out_specs=specs),
+                donate_argnums=(1, 2) if donate else ())      # BAD (gated)
+            ok = jax.jit(mapped, in_shardings=specs,
+                         donate_argnums=(1, 2))
+            ok2 = jax.jit(mapped, donate_argnums=())
+            ok3 = jax.jit(mapped)
+            return bad, bad2, ok, ok2, ok3
+        """}, rules=["donated-sharding"])
+    assert [(p, ln) for p, ln, _ in _rules_of(rep)] == [
+        ("parallel/d.py", 8), ("parallel/d.py", 9)]
+
+
+# ============================================ v2: CLI baseline/github
+def _run_cli(args, cwd=_REPO):
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tpulint"] + args,
+        capture_output=True, text=True, env=env, cwd=cwd)
+
+
+def test_cli_baseline_accepts_legacy_fails_new(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)
+        """})
+    base = str(tmp_path / "base.json")
+    r = _run_cli([pkg, "--rules=explicit-dtype", "--no-cache",
+                  f"--write-baseline={base}"])
+    assert r.returncode == 0, r.stderr
+    assert json.load(open(base))["counts"] == {
+        f"explicit-dtype|{os.path.join('pkg', 'learner', 'm.py')}": 1}
+    # legacy finding accepted -> exit 0
+    r = _run_cli([pkg, "--rules=explicit-dtype", "--no-cache",
+                  f"--baseline={base}"])
+    assert r.returncode == 0, r.stdout
+    assert "0 new finding(s), 1 accepted by baseline" in r.stdout
+    # a NEW finding -> exit 1, github annotation names it
+    with open(os.path.join(pkg, "learner", "m.py"), "a") as f:
+        f.write("def g(n):\n    return jnp.ones(n)\n")
+    r = _run_cli([pkg, "--rules=explicit-dtype", "--no-cache",
+                  f"--baseline={base}", "--format=github"])
+    assert r.returncode == 1, r.stdout
+    lines = [ln for ln in r.stdout.splitlines()
+             if ln.startswith("::error ")]
+    assert len(lines) == 1 and "line=6" in lines[0] \
+        and "explicit-dtype" in lines[0], r.stdout
+
+
+def test_cli_list_suppressions(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"m.py": """
+        def f():
+            print("x")  # tpulint: disable=no-bare-print -- fixture reason
+        """})
+    r = _run_cli([pkg, "--list-suppressions"])
+    assert r.returncode == 0
+    assert "fixture reason" in r.stdout
+    assert "1 suppression(s)" in r.stdout
+
+
+def test_cache_warm_run_matches_and_invalidates(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)
+        """})
+    cache = os.path.join(os.path.dirname(pkg), ".tpulint_cache.json")
+    cold = run_lint(pkg, rules=["explicit-dtype"], cache_path=cache)
+    assert os.path.exists(cache)
+    warm = run_lint(pkg, rules=["explicit-dtype"], cache_path=cache)
+    assert [f.to_dict() for f in warm.findings] == \
+        [f.to_dict() for f in cold.findings]
+    # edit the file: the cache must notice and re-analyze
+    p = os.path.join(pkg, "learner", "m.py")
+    src = open(p).read()
+    with open(p, "w") as f:
+        f.write(src + "def g(n):\n    return jnp.ones(n)\n")
+    os.utime(p, (os.path.getmtime(p) + 2, os.path.getmtime(p) + 2))
+    after = run_lint(pkg, rules=["explicit-dtype"], cache_path=cache)
+    assert len(after.active) == len(cold.active) + 1
+
+
+def test_package_clean_under_all_new_rules():
+    """The four ISSUE-6 rule families individually report zero
+    unsuppressed findings on the real package (the sweep fixed the
+    true positives: gbdt.py float_grads-after-donate for
+    donated-buffer-reuse, data_parallel.py donate-without-shardings
+    for donated-sharding)."""
+    for rule in ("no-dynamic-shape-in-jit", "donated-buffer-reuse",
+                 "spmd-axis-discipline", "donated-sharding"):
+        rep = run_lint(PACKAGE, rules=[rule])
+        assert rep.active == [], (rule, [f.render()
+                                         for f in rep.active])

@@ -2,24 +2,39 @@
 
 Rules (docs/StaticAnalysis.md):
 
-* no-host-sync-in-jit    — float()/int()/bool()/.item()/np.asarray()/
-                           .block_until_ready() on traced values in the
-                           static call graph rooted at the jax.jit entry
-                           points
-* no-tracer-branch       — Python if/while/assert on traced values
-* explicit-dtype         — jnp.zeros/ones/full/arange/array in device
-                           code must pass a dtype
-* collective-discipline  — lax.psum/pmean/all_gather only in parallel/
-                           or distributed.py
-* no-bare-print          — all output through utils.log / the event log
-* config-doc-sync        — config.py PARAMS <-> docs/Parameters.md
+* no-host-sync-in-jit      — float()/int()/bool()/.item()/np.asarray()/
+                             .block_until_ready() on traced values in
+                             the interprocedural call graph rooted at
+                             the jax.jit entry points (v2: methods,
+                             dispatch tables, higher-order arguments)
+* no-tracer-branch         — Python if/while/assert on traced values
+* no-dynamic-shape-in-jit  — nonzero/unique/1-arg where without size=,
+                             boolean-mask indexing, traced shape args
+* donated-buffer-reuse     — reading a binding after passing it in a
+                             donated position of a jitted entry
+* spmd-axis-discipline     — collective/PartitionSpec axis names match
+                             the declared mesh axes; collectives live
+                             under shard_map
+* donated-sharding         — jit(shard_map(...), donate_argnums=...)
+                             must pass explicit in_shardings
+* explicit-dtype           — jnp.zeros/ones/full/arange/array in device
+                             code must pass a dtype
+* collective-discipline    — lax.psum/pmean/all_gather only in
+                             parallel/ or distributed.py
+* donate-argnums           — score/grad/hess-shaped jit entries donate
+* no-device-put-in-loop    — no H2D transfers in Python loop bodies
+* no-bare-print            — all output through utils.log / event log
+* config-doc-sync          — config.py PARAMS <-> docs/Parameters.md
 
-Run:  python -m tools.tpulint [package_dir] [--format=json|text]
+Run:  python -m tools.tpulint [package_dir] [--format=json|text|github]
+      [--baseline=FILE] [--write-baseline=FILE] [--list-suppressions]
 Suppress:  # tpulint: disable=<rule>[,<rule>] -- <justification>
 """
 
 from .core import (Finding, LintContext, Report, Rule, RULES,  # noqa: F401
-                   register, run_lint)
+                   apply_baseline, baseline_counts, iter_suppressions,
+                   register, run_lint, write_baseline)
 
 __all__ = ["Finding", "LintContext", "Report", "Rule", "RULES",
-           "register", "run_lint"]
+           "apply_baseline", "baseline_counts", "iter_suppressions",
+           "register", "run_lint", "write_baseline"]

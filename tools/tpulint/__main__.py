@@ -3,14 +3,22 @@
 Exit status is the CI contract (wired into tier-1 via
 tests/test_tpulint.py; external CI calls this exactly the same way):
 
-    0  no unsuppressed findings
-    1  unsuppressed findings (or a rule/usage error)
+    0  no unsuppressed findings (with --baseline: no NEW findings)
+    1  unsuppressed/new findings (or a rule/usage error)
 
 Options:
-    --format=text|json   report format (default text; json is the
-                         machine-readable report)
-    --rules=a,b          run only the named rules
-    --list-rules         print the registry and exit
+    --format=text|json|github  report format (github emits workflow
+                               annotations: ::error file=...,line=...)
+    --rules=a,b                run only the named rules
+    --list-rules               print the registry and exit
+    --baseline=FILE            accept the legacy findings recorded in
+                               FILE; fail only on NEW ones
+    --write-baseline=FILE      record the current findings as the
+                               baseline and exit 0
+    --list-suppressions        audit every `# tpulint: disable` in the
+                               package (path, line, rules, why)
+    --no-cache                 disable the mtime-keyed analysis cache
+                               (.tpulint_cache.json next to the package)
 """
 
 from __future__ import annotations
@@ -18,7 +26,13 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .core import RULES, run_lint
+from .core import (RULES, apply_baseline, default_cache_path,
+                   iter_suppressions, run_lint, write_baseline)
+
+
+def _github_line(f) -> str:
+    return (f"::error file={f.path},line={f.line},col={f.col},"
+            f"title=tpulint {f.rule}::{f.message}")
 
 
 def main(argv=None) -> int:
@@ -27,10 +41,20 @@ def main(argv=None) -> int:
         description="JAX/TPU-aware static analysis (docs/StaticAnalysis.md)")
     ap.add_argument("package_dir", nargs="?", default="lightgbm_tpu",
                     help="package tree to lint (default: lightgbm_tpu)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="accept legacy findings from FILE; fail only "
+                         "on new ones")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="record current findings as the baseline")
+    ap.add_argument("--list-suppressions", action="store_true",
+                    help="list every justified tpulint disable comment")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the mtime-keyed analysis cache")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -39,18 +63,55 @@ def main(argv=None) -> int:
             sys.stdout.write(f"{name}: {RULES[name].description}\n")
         return 0
 
+    if args.list_suppressions:
+        n = 0
+        for path, line, rules, why in sorted(iter_suppressions(
+                args.package_dir)):
+            n += 1
+            sys.stdout.write(f"{path}:{line}: [{','.join(rules)}] "
+                             f"{why or '(MISSING JUSTIFICATION)'}\n")
+        sys.stdout.write(f"{n} suppression(s)\n")
+        return 0
+
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
+    cache = None if args.no_cache else default_cache_path(args.package_dir)
     try:
-        report = run_lint(args.package_dir, rules=rules)
+        report = run_lint(args.package_dir, rules=rules, cache_path=cache)
     except KeyError as e:
         sys.stderr.write(f"tpulint: {e.args[0]}\n")
         return 1
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, report)
+        sys.stdout.write(f"baseline written: {args.write_baseline} "
+                         f"({len(report.active)} finding(s) accepted)\n")
+        return 0
+
+    failing = report.active
+    accepted = 0
+    if args.baseline:
+        try:
+            failing, accepted = apply_baseline(report, args.baseline)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"tpulint: cannot read baseline "
+                             f"{args.baseline}: {e}\n")
+            return 1
+
     if args.format == "json":
         sys.stdout.write(report.to_json() + "\n")
+    elif args.format == "github":
+        for f in failing:
+            sys.stdout.write(_github_line(f) + "\n")
+        sys.stdout.write(f"{len(failing)} new finding(s), "
+                         f"{accepted} accepted by baseline, "
+                         f"{len(report.suppressed)} suppressed\n")
     else:
         sys.stdout.write(report.render_text() + "\n")
-    return 1 if report.active else 0
+        if args.baseline:
+            sys.stdout.write(f"{len(failing)} new finding(s), "
+                             f"{accepted} accepted by baseline\n")
+    return 1 if failing else 0
 
 
 if __name__ == "__main__":

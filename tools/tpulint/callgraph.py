@@ -1,37 +1,55 @@
-"""Static jit call graph + parameter-taint analysis for tpulint.
+"""Static jit call graph + parameter-taint analysis for tpulint (v2).
 
-The device-code rules (no-host-sync-in-jit, no-tracer-branch) need to
-know which code runs under `jax.jit` tracing and which values are
-tracers there.  Both are approximated statically:
+The device-code rules (no-host-sync-in-jit, no-tracer-branch,
+no-dynamic-shape-in-jit) need to know which code runs under `jax.jit`
+tracing and which values are tracers there.  Both are approximated
+statically:
 
 * **Roots**: every function wrapped in jit anywhere in the package —
-  `@jax.jit`, `@functools.partial(jax.jit, static_argnames=...)`, and
-  the assignment form `f = jax.jit(g, ...)` where `g` is a local
-  function.  `static_argnames`/`static_argnums` are honored: those
-  parameters are Python values at trace time, and branching on them is
-  exactly how static configuration is supposed to work.
+  `@jax.jit`, `@functools.partial(jax.jit, static_argnames=...)`, the
+  assignment form `f = jax.jit(g, ...)`, the attribute form
+  `self._fn = jax.jit(g, ...)`, and `jax.jit(factory(...))` where the
+  in-package factory returns a locally-defined function.
+  `static_argnames`/`static_argnums` are honored: those parameters are
+  Python values at trace time, and branching on them is exactly how
+  static configuration is supposed to work.
 
-* **Call graph**: from each root, calls to other functions defined in
-  the package (same module or via `from ..mod import name` imports) are
-  resolved and the callee is analyzed too, with its parameters tainted
-  per call site (a traced argument taints the bound parameter; a static
-  one does not).  Iterated to a fixpoint, so taint flows through helper
-  layers (grow_tree -> find_best_split -> leaf_gain).
+* **Call graph (v2 — interprocedural)**: from each root, callees are
+  resolved through
+
+  - direct calls to package functions (same module or imported, with
+    re-export chains like `learner/__init__.py` followed);
+  - **method calls**: `self.m()` / `cls.m()` resolve through a class-
+    hierarchy pass (in-package base classes included), binding call-
+    site taints to the method's parameters after `self`;
+  - **containers**: names bound to dict/list/tuple literals of
+    functions (`TABLE = {"a": f}`; `self._fns[k] = fn`) — a call
+    through the container (`TABLE[key](...)`) reaches every member;
+  - **value bindings**: names bound to functions indirectly
+    (`g = f`, `g = jax.jit(f)`, `g = a if c else b`, factory returns);
+  - **function-valued arguments**: a function reference passed as an
+    argument marks the callee's parameter, and calls of that parameter
+    inside the callee dispatch to the referenced functions.
+
+  Taint is iterated to a fixpoint, so it flows through helper layers
+  (grow_tree -> find_best_split -> leaf_gain), through method
+  indirection, and through the jit-entry tables the boosting loop
+  dispatches on.
 
 * **Taint**: within one root, a flat name->tainted environment seeded by
   the non-static parameters.  Assignments propagate taint through
-  expressions; `.shape`/`.ndim`/`.dtype`/`.size` access yields a STATIC
+  expressions; `.shape/.ndim/.dtype/.size` access yields a STATIC
   value even on a tracer (that's how jit code legitimately branches on
   geometry), and `is`/`is not` comparisons are host-safe identity
   checks.  Functions passed to `lax.fori_loop`/`while_loop`/`scan`/
   `cond`/`switch` and `jax.vmap` get their parameters tainted per the
   lax calling contract (the loop index and carry are tracers).
 
-The approximation is deliberately parameter-rooted (matching the rule
-names): device constants built from static shapes are not tracked, and
-dynamic dispatch (methods on objects, functions stored in containers)
-is not resolved.  That keeps false positives near zero on idiomatic
-JAX; the fixture tests in tests/test_tpulint.py pin the contract.
+Not resolved (kept deliberately out to hold false positives near
+zero): methods on objects whose class cannot be determined from the
+expression (`objective.get_gradients(...)` on a closure variable), and
+constructor calls.  The fixture tests in tests/test_tpulint.py pin the
+contract.
 """
 
 from __future__ import annotations
@@ -62,6 +80,9 @@ _LAX_HOF = {
     "switch": [],
 }
 
+_JIT_NAMES = ("jax.jit", "jit")
+_PARTIAL_NAMES = ("functools.partial", "partial")
+
 
 @dataclass
 class FuncInfo:
@@ -70,9 +91,12 @@ class FuncInfo:
     module: "ModuleInfo"
     qualname: str
     jit_root: bool = False
+    owner_class: Optional["ClassInfo"] = None
     static_params: Set[str] = field(default_factory=set)
     # accumulated tainted parameter names (grows monotonically)
     tainted_params: Set[str] = field(default_factory=set)
+    # param name -> functions possibly bound to it (higher-order flow)
+    param_funcs: Dict[str, Set[int]] = field(default_factory=dict)
 
     @property
     def param_names(self) -> List[str]:
@@ -83,26 +107,65 @@ class FuncInfo:
         return names
 
 
+@dataclass
+class ClassInfo:
+    """One in-package class: methods + function-valued attributes."""
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    bases: List["ClassInfo"] = field(default_factory=list)
+    # attr name -> functions possibly bound via `self.attr = ...` /
+    # `self.attr[k] = ...` / class-body assignment (grows monotonically)
+    attr_funcs: Dict[str, Set[int]] = field(default_factory=dict)
+
+    def find_method(self, name: str) -> Optional[FuncInfo]:
+        if name in self.methods:
+            return self.methods[name]
+        for base in self.bases:
+            m = base.find_method(name)
+            if m is not None:
+                return m
+        return None
+
+    def find_attr_funcs(self, name: str) -> Set[int]:
+        out: Set[int] = set(self.attr_funcs.get(name, ()))
+        for base in self.bases:
+            out |= base.find_attr_funcs(name)
+        return out
+
+
 class ModuleInfo:
-    """Per-file index: imports and top-level functions."""
+    """Per-file index: imports, top-level functions, classes, and
+    module-level value bindings."""
 
     def __init__(self, pf, package_name: str):
         self.pf = pf
         self.package_name = package_name
         # module dotted name, e.g. lightgbm_tpu.learner.grow
         parts = pf.rel[:-3].split(os.sep)
-        if parts[-1] == "__init__":
+        self.is_package = parts[-1] == "__init__"
+        if self.is_package:
             parts = parts[:-1]
         self.dotted = ".".join(parts)
         self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
         self.top_funcs: Dict[str, FuncInfo] = {}
+        self.top_classes: Dict[str, ClassInfo] = {}
+        # module-level name -> RHS expression(s) it was assigned
+        self.binding_exprs: Dict[str, List[ast.AST]] = {}
+        # resolved: module-level name -> referenced functions
+        self.value_bindings: Dict[str, Set[int]] = {}
         if pf.tree is not None:
             self._index(pf.tree)
 
     def _resolve_relative(self, level: int, module: Optional[str]) -> str:
         base = self.dotted.split(".")
         # level=1 strips the module's own name, 2 strips one package, ...
-        base = base[:len(base) - level]
+        # — except in a package __init__, whose dotted name IS the
+        # package, so level 1 strips nothing there
+        strip = level - 1 if self.is_package else level
+        if strip:
+            base = base[:len(base) - strip]
         if module:
             base = base + module.split(".")
         return ".".join(base)
@@ -122,6 +185,21 @@ class ModuleInfo:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.top_funcs[node.name] = FuncInfo(
                     node=node, module=self, qualname=node.name)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, module=self, node=node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        ci.methods[item.name] = FuncInfo(
+                            node=item, module=self,
+                            qualname=f"{node.name}.{item.name}",
+                            owner_class=ci)
+                self.top_classes[node.name] = ci
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.binding_exprs.setdefault(t.id, []).append(
+                            node.value)
 
     def dotted_of(self, expr: ast.AST) -> Optional[str]:
         """Resolve an expression to a dotted module path, following this
@@ -143,16 +221,66 @@ class ModuleInfo:
 
 
 class PackageIndex:
-    """All modules of the linted package + jit roots."""
+    """All modules of the linted package + jit roots + class hierarchy +
+    value bindings."""
 
     def __init__(self, ctx):
         self.ctx = ctx
         self.modules: Dict[str, ModuleInfo] = {}
+        # id(FuncInfo) -> FuncInfo (value bindings store ids so the sets
+        # stay hashable across dataclass instances)
+        self.funcs_by_id: Dict[int, FuncInfo] = {}
         for pf in ctx.files:
             mi = ModuleInfo(pf, ctx.package_name)
             self.modules[mi.dotted] = mi
+        self._register_known_funcs()
+        self._link_bases()
+        self._resolve_bindings()
         for mi in self.modules.values():
             self._mark_jit_roots(mi)
+        self._collect_class_attrs()
+
+    def func(self, fid: int) -> FuncInfo:
+        return self.funcs_by_id[fid]
+
+    def _remember(self, fi: FuncInfo) -> int:
+        self.funcs_by_id[id(fi)] = fi
+        return id(fi)
+
+    def _register_known_funcs(self) -> None:
+        for mi in self.modules.values():
+            for fi in mi.top_funcs.values():
+                self._remember(fi)
+            for ci in mi.top_classes.values():
+                for fi in ci.methods.values():
+                    self._remember(fi)
+
+    def _link_bases(self) -> None:
+        for mi in self.modules.values():
+            for ci in mi.top_classes.values():
+                for base in ci.node.bases:
+                    bci = self._resolve_class(mi, base)
+                    if bci is not None:
+                        ci.bases.append(bci)
+
+    def _resolve_class(self, mi: ModuleInfo, expr: ast.AST
+                       ) -> Optional[ClassInfo]:
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.top_classes:
+                return mi.top_classes[expr.id]
+            imp = mi.imports.get(expr.id)
+            if imp:
+                tgt = self.modules.get(imp[0])
+                if tgt and imp[1] and imp[1] in tgt.top_classes:
+                    return tgt.top_classes[imp[1]]
+        elif isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                            ast.Name):
+            imp = mi.imports.get(expr.value.id)
+            if imp and imp[1] is None:
+                tgt = self.modules.get(imp[0])
+                if tgt and expr.attr in tgt.top_classes:
+                    return tgt.top_classes[expr.attr]
+        return None
 
     # ---- jit root discovery ----
 
@@ -165,31 +293,60 @@ class PackageIndex:
                 for dec in node.decorator_list:
                     statics = self._jit_decorator_statics(mi, dec, node)
                     if statics is not None:
-                        fi = mi.top_funcs.get(node.name)
-                        if fi is None or fi.node is not node:
-                            fi = FuncInfo(node=node, module=mi,
-                                          qualname=node.name)
-                            mi.top_funcs.setdefault(
-                                f"<nested>{id(node)}", fi)
+                        fi = self._func_for_def(mi, node)
                         fi.jit_root = True
                         fi.static_params |= statics
             elif isinstance(node, ast.Call):
                 # assignment/expression form: jax.jit(fn, ...)
                 if self._is_jit_name(mi, node.func) and node.args:
-                    target = node.args[0]
-                    if isinstance(target, ast.Name):
-                        fi = self._find_def_anywhere(mi, target.id)
-                        if fi is not None:
-                            fi.jit_root = True
-                            fi.static_params |= self._static_names_of(
-                                mi, node, fi.node)
-                    elif isinstance(target, ast.Lambda):
-                        fi = FuncInfo(node=target, module=mi,
-                                      qualname="<lambda>")
+                    for fi in self._jit_target_funcs(mi, node.args[0]):
                         fi.jit_root = True
                         fi.static_params |= self._static_names_of(
-                            mi, node, target)
-                        mi.top_funcs[f"<lambda>{id(target)}"] = fi
+                            mi, node, fi.node)
+
+    def _func_for_def(self, mi: ModuleInfo, node: ast.AST) -> FuncInfo:
+        """FuncInfo for a def node, registering nested/method defs that
+        are not already indexed."""
+        fi = mi.top_funcs.get(getattr(node, "name", ""))
+        if fi is not None and fi.node is node:
+            return fi
+        for ci in mi.top_classes.values():
+            m = ci.methods.get(getattr(node, "name", ""))
+            if m is not None and m.node is node:
+                return m
+        for key, cand in mi.top_funcs.items():
+            if cand.node is node:
+                return cand
+        fi = FuncInfo(node=node, module=mi,
+                      qualname=getattr(node, "name", "<lambda>"))
+        mi.top_funcs[f"<nested>{id(node)}"] = fi
+        self._remember(fi)
+        return fi
+
+    def _jit_target_funcs(self, mi: ModuleInfo, target: ast.AST
+                          ) -> List[FuncInfo]:
+        """Functions actually traced by `jax.jit(target, ...)`."""
+        if isinstance(target, ast.Name):
+            fi = self._find_def_anywhere(mi, target.id)
+            if fi is not None:
+                return [fi]
+            # imported (possibly re-exported) function
+            return [self.func(fid)
+                    for fid in self.resolve_name(mi, target.id)]
+        if isinstance(target, ast.Lambda):
+            fi = FuncInfo(node=target, module=mi, qualname="<lambda>")
+            mi.top_funcs[f"<lambda>{id(target)}"] = fi
+            self._remember(fi)
+            return [fi]
+        if isinstance(target, ast.Call):
+            # jit(factory(...)): the factory's returned local functions
+            # are the traced entries (inference/predictor.py _program)
+            out: List[FuncInfo] = []
+            for fid in self._resolve_value_ref(mi, target.func, None, None):
+                for rid in self.returned_funcs(self.func(fid)):
+                    out.append(self.func(rid))
+            return out
+        return []
 
     def _find_def_anywhere(self, mi: ModuleInfo, name: str
                            ) -> Optional[FuncInfo]:
@@ -198,14 +355,11 @@ class PackageIndex:
         for node in ast.walk(mi.pf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name == name:
-                fi = FuncInfo(node=node, module=mi, qualname=name)
-                mi.top_funcs[f"<nested>{id(node)}"] = fi
-                return fi
+                return self._func_for_def(mi, node)
         return None
 
     def _is_jit_name(self, mi: ModuleInfo, expr: ast.AST) -> bool:
-        dotted = mi.dotted_of(expr)
-        return dotted in ("jax.jit", "jit")
+        return mi.dotted_of(expr) in _JIT_NAMES
 
     def _jit_decorator_statics(self, mi: ModuleInfo, dec: ast.AST,
                                fn: ast.AST) -> Optional[Set[str]]:
@@ -215,7 +369,7 @@ class PackageIndex:
             return set()
         if isinstance(dec, ast.Call):
             dotted = mi.dotted_of(dec.func)
-            if dotted in ("functools.partial", "partial") and dec.args \
+            if dotted in _PARTIAL_NAMES and dec.args \
                     and self._is_jit_name(mi, dec.args[0]):
                 return self._static_names_of(mi, dec, fn)
             if self._is_jit_name(mi, dec.func):
@@ -243,12 +397,203 @@ class PackageIndex:
                             out.add(params[v.value])
         return out
 
-    # ---- cross-module function resolution ----
+    # ---- value bindings / function references --------------------------
+
+    def _resolve_bindings(self) -> None:
+        """Module-level `name = <expr referencing functions>` bindings,
+        iterated so chains across modules settle (g = jax.jit(f) in one
+        module, re-exported and re-bound in another)."""
+        for _ in range(4):
+            changed = False
+            for mi in self.modules.values():
+                for name, exprs in mi.binding_exprs.items():
+                    refs: Set[int] = set()
+                    for e in exprs:
+                        refs |= self.collect_refs(mi, e, None, None)
+                    cur = mi.value_bindings.setdefault(name, set())
+                    if refs - cur:
+                        cur |= refs
+                        changed = True
+            if not changed:
+                break
+
+    def _collect_class_attrs(self) -> None:
+        """`self.attr = <expr>` / `self.attr[k] = <expr>` anywhere in a
+        class's methods (plus class-body assignments) -> attr_funcs."""
+        for _ in range(4):
+            changed = False
+            for mi in self.modules.values():
+                for ci in mi.top_classes.values():
+                    for item in ci.node.body:
+                        if isinstance(item, ast.Assign):
+                            refs = self.collect_refs(mi, item.value, ci,
+                                                     None)
+                            for t in item.targets:
+                                if isinstance(t, ast.Name) and refs:
+                                    cur = ci.attr_funcs.setdefault(
+                                        t.id, set())
+                                    if refs - cur:
+                                        cur |= refs
+                                        changed = True
+                    for node in ast.walk(ci.node):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        refs = None
+                        for t in node.targets:
+                            attr = self._self_attr_target(t)
+                            if attr is None:
+                                continue
+                            if refs is None:
+                                refs = self.collect_refs(
+                                    mi, node.value, ci, None)
+                            if refs:
+                                cur = ci.attr_funcs.setdefault(attr, set())
+                                if refs - cur:
+                                    cur |= refs
+                                    changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _self_attr_target(t: ast.AST) -> Optional[str]:
+        """`self.attr` or `self.attr[k]` assignment target -> attr."""
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id in ("self", "cls"):
+            return t.attr
+        return None
+
+    def collect_refs(self, mi: ModuleInfo, expr: Optional[ast.AST],
+                     owner_class: Optional[ClassInfo],
+                     local_map: Optional[Dict[str, Set[int]]]) -> Set[int]:
+        """Function references appearing in VALUE position inside `expr`
+        (not in call position), looking through jit wrappers, containers,
+        conditionals, and in-package factory returns."""
+        out: Set[int] = set()
+        if expr is None:
+            return out
+        if isinstance(expr, (ast.Name, ast.Attribute, ast.Subscript)):
+            return self._resolve_value_ref(mi, expr, owner_class,
+                                           local_map)
+        if isinstance(expr, ast.Call):
+            dotted = mi.dotted_of(expr.func) or ""
+            if dotted in _JIT_NAMES or (dotted in _PARTIAL_NAMES
+                                        and expr.args):
+                return self.collect_refs(mi, expr.args[0], owner_class,
+                                         local_map)
+            # in-package factory: its returned local functions
+            for fid in self._resolve_value_ref(mi, expr.func, owner_class,
+                                               local_map):
+                out |= self.returned_funcs(self.func(fid))
+            # wrappers (RecompileDetector(fn, ...)): references in args
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+                out |= self.collect_refs(mi, a, owner_class, local_map)
+            return out
+        if isinstance(expr, ast.Dict):
+            for v in expr.values:
+                out |= self.collect_refs(mi, v, owner_class, local_map)
+            return out
+        if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+            for v in expr.elts:
+                out |= self.collect_refs(mi, v, owner_class, local_map)
+            return out
+        if isinstance(expr, ast.IfExp):
+            return (self.collect_refs(mi, expr.body, owner_class,
+                                      local_map)
+                    | self.collect_refs(mi, expr.orelse, owner_class,
+                                        local_map))
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                out |= self.collect_refs(mi, v, owner_class, local_map)
+            return out
+        return out
+
+    def _resolve_value_ref(self, mi: ModuleInfo, expr: ast.AST,
+                           owner_class: Optional[ClassInfo],
+                           local_map: Optional[Dict[str, Set[int]]]
+                           ) -> Set[int]:
+        """A Name/Attribute/Subscript in value position -> functions it
+        may denote."""
+        if isinstance(expr, ast.Subscript):
+            # container[key] -> the container's members
+            return self._resolve_value_ref(mi, expr.value, owner_class,
+                                           local_map)
+        if isinstance(expr, ast.Name):
+            if local_map and expr.id in local_map:
+                return set(local_map[expr.id])
+            return self.resolve_name(mi, expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id in ("self", "cls") \
+                    and owner_class is not None:
+                m = owner_class.find_method(expr.attr)
+                out = {id(m)} if m is not None else set()
+                return out | owner_class.find_attr_funcs(expr.attr)
+            # ClassName.method
+            ci = self._resolve_class(mi, expr.value)
+            if ci is not None:
+                m = ci.find_method(expr.attr)
+                return {id(m)} if m is not None else set()
+            # module.func through imports
+            if isinstance(expr.value, ast.Name):
+                imp = mi.imports.get(expr.value.id)
+                if imp and imp[1] is None:
+                    tgt = self.modules.get(imp[0])
+                    if tgt is not None:
+                        return self.resolve_name(tgt, expr.attr)
+        return set()
+
+    def resolve_name(self, mi: ModuleInfo, name: str,
+                     _seen: Optional[Set[Tuple[str, str]]] = None
+                     ) -> Set[int]:
+        """A bare name in `mi` -> functions it denotes, following
+        defs, value bindings, and import/re-export chains."""
+        _seen = _seen or set()
+        key = (mi.dotted, name)
+        if key in _seen:
+            return set()
+        _seen.add(key)
+        if name in mi.top_funcs:
+            return {id(mi.top_funcs[name])}
+        out: Set[int] = set(mi.value_bindings.get(name, ()))
+        imp = mi.imports.get(name)
+        if imp:
+            mod, attr = imp
+            tgt = self.modules.get(mod)
+            if tgt is not None and attr:
+                out |= self.resolve_name(tgt, attr, _seen)
+        return out
+
+    def returned_funcs(self, fi: FuncInfo) -> Set[int]:
+        """Locally-defined functions `fi` may return (factory pattern:
+        make_sharded_wave_fn returns `call`)."""
+        cached = getattr(fi, "_returned", None)
+        if cached is not None:
+            return cached
+        fi._returned = set()  # type: ignore[attr-defined]  # cycle guard
+        out: Set[int] = set()
+        nested: Dict[str, ast.AST] = {}
+        if not isinstance(fi.node, ast.Lambda):
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not fi.node:
+                    nested.setdefault(node.name, node)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in nested:
+                    out.add(id(self._func_for_def(fi.module,
+                                                  nested[node.value.id])))
+        fi._returned = out  # type: ignore[attr-defined]
+        return out
+
+    # ---- call resolution ----------------------------------------------
 
     def resolve_call(self, mi: ModuleInfo, func: ast.AST
                      ) -> Optional[FuncInfo]:
-        """Resolve a Call's func expression to an in-package FuncInfo
-        (same-module top-level functions or `from x import f` names)."""
+        """v1-compatible single-target resolution (direct calls only)."""
         if isinstance(func, ast.Name):
             if func.id in mi.top_funcs:
                 return mi.top_funcs[func.id]
@@ -266,6 +611,72 @@ class PackageIndex:
                 if tgt and func.attr in tgt.top_funcs:
                     return tgt.top_funcs[func.attr]
         return None
+
+    def resolve_call_multi(self, mi: ModuleInfo, func: ast.AST,
+                           owner_class: Optional[ClassInfo] = None,
+                           local_map: Optional[Dict[str, Set[int]]] = None,
+                           param_funcs: Optional[Dict[str, Set[int]]] = None
+                           ) -> List[Tuple[FuncInfo, int]]:
+        """All in-package functions a call's func expression may reach,
+        as (callee, param_offset) — offset 1 for bound-method calls
+        (`self.m(...)` binds args from the second parameter on)."""
+        out: List[Tuple[FuncInfo, int]] = []
+        seen: Set[int] = set()
+
+        def add(fid: int, offset: int) -> None:
+            if fid not in seen:
+                seen.add(fid)
+                out.append((self.func(fid), offset))
+
+        if isinstance(func, ast.Name):
+            if param_funcs and func.id in param_funcs:
+                for fid in param_funcs[func.id]:
+                    add(fid, 0)
+                return out
+            if local_map and func.id in local_map:
+                for fid in local_map[func.id]:
+                    fi = self.func(fid)
+                    add(fid, 1 if fi.owner_class is not None else 0)
+                return out
+            fi = self.resolve_call(mi, func)
+            if fi is not None:
+                add(id(fi), 0)
+                return out
+            for fid in self.resolve_name(mi, func.id):
+                add(fid, 0)
+            return out
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and owner_class is not None:
+                m = owner_class.find_method(func.attr)
+                if m is not None:
+                    add(id(m), 1)
+                for fid in owner_class.find_attr_funcs(func.attr):
+                    fi = self.func(fid)
+                    # a bound method stored in the table still binds
+                    # args after self; plain functions bind from 0
+                    add(fid, 1 if fi.owner_class is not None else 0)
+                return out
+            ci = self._resolve_class(mi, base)
+            if ci is not None:
+                m = ci.find_method(func.attr)
+                if m is not None:
+                    add(id(m), 0)  # Cls.m(obj, ...) binds from `self`
+                return out
+            fi = self.resolve_call(mi, func)
+            if fi is not None:
+                add(id(fi), 0)
+            return out
+        if isinstance(func, ast.Subscript):
+            # TABLE[key](...) — every container member
+            for fid in self._resolve_value_ref(mi, func, owner_class,
+                                               local_map):
+                fi = self.func(fid)
+                add(fid, 1 if fi.owner_class is not None
+                    and isinstance(func.value, ast.Attribute) else 0)
+            return out
+        return out
 
 
 def walk_scope(root: ast.AST):
@@ -373,6 +784,7 @@ class TaintWalker:
         self.index = index
         self.mi = fi.module
         self.fi = fi
+        self.owner_class = fi.owner_class
         # scope tree + node -> owning scope map
         self.scopes: List[Scope] = []
         self.scope_of_def: Dict[int, Scope] = {}
@@ -389,6 +801,20 @@ class TaintWalker:
                 name = getattr(node, "name", None)
                 if name and name not in self.nested:
                     self.nested[name] = node
+        # function-valued local bindings (tables built in this function)
+        self.local_funcs: Dict[str, Set[int]] = {}
+        if not isinstance(fi.node, ast.Lambda):
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign):
+                    refs = index.collect_refs(self.mi, node.value,
+                                              self.owner_class, None)
+                    if refs:
+                        for t in node.targets:
+                            tt = t.value if isinstance(t, ast.Subscript) \
+                                else t
+                            if isinstance(tt, ast.Name):
+                                self.local_funcs.setdefault(
+                                    tt.id, set()).update(refs)
         # taints discovered for in-package callees: FuncInfo -> set(param)
         self.callee_taints: Dict[int, Tuple[FuncInfo, Set[str]]] = {}
 
@@ -482,6 +908,12 @@ class TaintWalker:
             if isinstance(node, ast.Name):
                 scope.add_taint(node.id)
 
+    def _funcs_of_expr(self, node: ast.AST) -> Set[int]:
+        """Function references an argument expression may denote (for
+        higher-order parameter binding)."""
+        return self.index.collect_refs(self.mi, node, self.owner_class,
+                                       self.local_funcs)
+
     def _taint_callee_params(self, node: ast.AST, first_k: int) -> None:
         """Mark the first `first_k` parameters of a locally-nested or
         in-package function as tainted (lax/vmap calling contracts)."""
@@ -493,11 +925,10 @@ class TaintWalker:
                 for p in fn.args.args[:first_k]:
                     child.tainted.add(p.arg)
             return
-        if name:
-            fi = self.index.resolve_call(self.mi, node)
-            if fi is not None:
-                names = fi.param_names[:first_k]
-                self._record_callee(fi, set(names) - fi.static_params)
+        for fid in self._funcs_of_expr(node):
+            fi = self.index.func(fid)
+            names = fi.param_names[:first_k]
+            self._record_callee(fi, set(names) - fi.static_params)
 
     def _record_callee(self, fi: FuncInfo, tainted: Set[str]) -> None:
         tainted = tainted - fi.static_params
@@ -524,6 +955,44 @@ class TaintWalker:
         for kw in e.keywords:
             if kw.arg and kw.arg in params and self._taint(kw.value, scope):
                 child.tainted.add(kw.arg)
+
+    def _bind_call_args(self, fi: FuncInfo, offset: int, e: ast.Call,
+                        scope: Scope) -> None:
+        """Record tainted params and function-valued args for one
+        resolved in-package callee."""
+        if fi.node is self.fi.node:
+            return
+        params = fi.param_names
+        tainted: Set[str] = set()
+        func_bound = False
+        for i, a in enumerate(e.args):
+            if isinstance(a, ast.Starred):
+                continue
+            pi = i + offset
+            if pi >= len(params):
+                continue
+            if self._taint(a, scope):
+                tainted.add(params[pi])
+            refs = self._funcs_of_expr(a)
+            if refs:
+                cur = fi.param_funcs.setdefault(params[pi], set())
+                if refs - cur:
+                    cur |= refs
+                    func_bound = True
+        for kw in e.keywords:
+            if not kw.arg:
+                continue
+            if self._taint(kw.value, scope):
+                tainted.add(kw.arg)
+            refs = self._funcs_of_expr(kw.value)
+            if refs and kw.arg in params:
+                cur = fi.param_funcs.setdefault(kw.arg, set())
+                if refs - cur:
+                    cur |= refs
+                    func_bound = True
+        if func_bound:
+            self._param_funcs_changed = True
+        self._record_callee(fi, tainted)
 
     def _propagate_call(self, e: ast.Call, scope: Scope) -> None:
         """Taint flow into nested functions / package callees."""
@@ -552,22 +1021,16 @@ class TaintWalker:
         if isinstance(e.func, ast.Name) and e.func.id in self.nested:
             self._taint_def_params(self.nested[e.func.id], e, scope)
             return
-        # direct call to an in-package function
-        fi = self.index.resolve_call(self.mi, e.func)
-        if fi is not None and fi.node is not self.fi.node:
-            params = fi.param_names
-            tainted: Set[str] = set()
-            for i, a in enumerate(e.args):
-                if isinstance(a, ast.Starred):
-                    continue
-                if i < len(params) and self._taint(a, scope):
-                    tainted.add(params[i])
-            for kw in e.keywords:
-                if kw.arg and self._taint(kw.value, scope):
-                    tainted.add(kw.arg)
-            self._record_callee(fi, tainted)
+        # calls through a tainted-parameter function value, methods,
+        # containers, bindings, and plain package functions
+        params = {p: f for p, f in self.fi.param_funcs.items()}
+        for fi, offset in self.index.resolve_call_multi(
+                self.mi, e.func, self.owner_class, self.local_funcs,
+                params):
+            self._bind_call_args(fi, offset, e, scope)
 
     def run_env_fixpoint(self, max_iter: int = 16) -> None:
+        self._param_funcs_changed = False
         for _ in range(max_iter):
             before = self._changed()
             for scope in self.scopes:
@@ -590,15 +1053,11 @@ class TaintWalker:
                         if node.optional_vars is not None \
                                 and self._taint(node.context_expr, scope):
                             self._bind_names(node.optional_vars, scope)
-                    elif isinstance(node, ast.Return):
-                        # `return tracer` marks the function name itself
-                        # nothing: call-result taint is approximated by
-                        # argument taint in _taint (Call case)
-                        pass
                     elif isinstance(node, ast.Call):
                         self._propagate_call(node, scope)
             if self._changed() == before:
                 break
+
 
 def build_reachable(index: PackageIndex) -> List[FuncInfo]:
     """Fixpoint over the call graph: analyze every jit root, propagate
@@ -608,13 +1067,13 @@ def build_reachable(index: PackageIndex) -> List[FuncInfo]:
     `_walker` for the rules to consume."""
     work: List[FuncInfo] = []
     for mi in index.modules.values():
-        for fi in mi.top_funcs.values():
+        roots = list(mi.top_funcs.values())
+        for ci in mi.top_classes.values():
+            roots += list(ci.methods.values())
+        for fi in roots:
             if fi.jit_root:
-                a = fi.node.args
-                names = [p.arg for p in getattr(a, "posonlyargs", [])]
-                names += [p.arg for p in a.args]
-                names += [p.arg for p in a.kwonlyargs]
-                fi.tainted_params = set(names) - fi.static_params
+                fi.tainted_params = (set(fi.param_names)
+                                     - fi.static_params - {"self", "cls"})
                 work.append(fi)
     analyzed: Dict[int, FuncInfo] = {}
     for _ in range(20):  # cross-function fixpoint
@@ -628,6 +1087,8 @@ def build_reachable(index: PackageIndex) -> List[FuncInfo]:
             seen.add(id(fi))
             walker = TaintWalker(index, fi)
             walker.run_env_fixpoint()
+            if walker._param_funcs_changed:
+                changed = True
             fi._walker = walker  # type: ignore[attr-defined]
             analyzed[id(fi)] = fi
             for _, (callee, taints) in walker.callee_taints.items():

@@ -31,7 +31,7 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*disable(?P<next>-next)?\s*=\s*"
@@ -143,11 +143,26 @@ class LintContext:
 class Rule:
     """Base class: subclasses set `name`/`description` and implement
     check().  Adding a rule = subclass + @register (docs/StaticAnalysis.md
-    "Adding a rule")."""
+    "Adding a rule").
+
+    Rules whose findings depend on ONE file at a time set
+    `file_local = True` and implement `check_file(ctx, pf)`; the
+    mtime-keyed cache then reuses their per-file results for unchanged
+    files.  Graph rules (anything consuming the jit call graph) stay
+    file_local = False and re-run whenever any file changed."""
     name: str = ""
     description: str = ""
+    file_local: bool = False
 
     def check(self, ctx: LintContext) -> List[Finding]:
+        if not self.file_local:
+            raise NotImplementedError
+        out: List[Finding] = []
+        for pf in ctx.files:
+            out.extend(self.check_file(ctx, pf))
+        return out
+
+    def check_file(self, ctx: LintContext, pf: PyFile) -> List[Finding]:
         raise NotImplementedError
 
 
@@ -219,13 +234,92 @@ def _apply_suppressions(ctx: LintContext, findings: List[Finding]
     return findings
 
 
+# ------------------------------------------------------------------ cache
+# mtime-keyed analysis cache (docs/StaticAnalysis.md "Caching"): the
+# full-package lint re-parses every file and rebuilds the jit call
+# graph, which grows with the package.  The cache keys on every file's
+# (mtime_ns, size) plus tpulint's own sources: a fully-unchanged
+# package returns the stored report without any analysis (sub-second);
+# when only some files changed, file-local rules reuse their per-file
+# results for the unchanged ones and graph rules re-run.
+
+CACHE_VERSION = 1
+
+
+def _tool_fingerprint() -> List:
+    d = os.path.dirname(os.path.abspath(__file__))
+    items: List = []
+    for root, dirs, files in os.walk(d):
+        dirs[:] = sorted(x for x in dirs if x != "__pycache__")
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                p = os.path.join(root, fname)
+                st = os.stat(p)
+                items.append([os.path.relpath(p, d),
+                              int(st.st_mtime_ns), st.st_size])
+    return items
+
+
+def _stat_key(path: str) -> Optional[List]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [int(st.st_mtime_ns), st.st_size]
+
+
+def _load_cache(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if isinstance(data, dict):
+            return data
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _save_cache(path: str, data: Dict) -> None:
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a cache problem must never fail the lint
+
+
+def default_cache_path(package_dir: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(package_dir)),
+                        ".tpulint_cache.json")
+
+
 def run_lint(package_dir: str, rules: Optional[List[str]] = None,
-             docs_dir: Optional[str] = None) -> Report:
-    """Run the (selected) rules over one package tree."""
+             docs_dir: Optional[str] = None,
+             cache_path: Optional[str] = None) -> Report:
+    """Run the (selected) rules over one package tree.  With
+    `cache_path`, reuse mtime-keyed results (see module comment)."""
     # rule modules self-register on import
     from . import rules as _rules  # noqa: F401
     ctx = LintContext(package_dir, docs_dir=docs_dir)
     selected = list(RULES) if rules is None else list(rules)
+    for name in selected:
+        if name not in RULES:
+            raise KeyError(f"unknown tpulint rule: {name} "
+                           f"(known: {', '.join(sorted(RULES))})")
+
+    fkeys = {pf.rel: _stat_key(pf.abspath) for pf in ctx.files}
+    meta = {"version": CACHE_VERSION, "tool": _tool_fingerprint(),
+            "rules": sorted(selected),
+            "docs": _stat_key(os.path.join(ctx.docs_dir,
+                                           "Parameters.md"))}
+    cache = _load_cache(cache_path) if cache_path else None
+    if cache is not None and cache.get("meta") != meta:
+        cache = None  # tool or rule set changed: full invalidation
+    if cache is not None and cache.get("files") == fkeys:
+        return Report(findings=[Finding(**d)
+                                for d in cache.get("findings", [])])
+
     findings: List[Finding] = []
     for pf in ctx.files:
         if pf.parse_error is not None:
@@ -233,12 +327,86 @@ def run_lint(package_dir: str, rules: Optional[List[str]] = None,
                 rule="syntax-error", path=pf.rel,
                 line=pf.parse_error.lineno or 0, col=0,
                 message=f"cannot parse: {pf.parse_error.msg}"))
+    cached_files = (cache or {}).get("files", {})
+    cached_per_file = (cache or {}).get("per_file", {})
+    per_file: Dict[str, Dict[str, List[Dict]]] = {}
     for name in selected:
-        rule = RULES.get(name)
-        if rule is None:
-            raise KeyError(f"unknown tpulint rule: {name} "
-                           f"(known: {', '.join(sorted(RULES))})")
-        findings.extend(rule.check(ctx))
+        rule = RULES[name]
+        if not rule.file_local:
+            findings.extend(rule.check(ctx))
+            continue
+        for pf in ctx.files:
+            unchanged = (cached_files.get(pf.rel) == fkeys[pf.rel])
+            cached_l = (cached_per_file.get(pf.rel, {}).get(name)
+                        if unchanged else None)
+            if cached_l is not None:
+                fs = [Finding(**d) for d in cached_l]
+                for f in fs:
+                    f.suppressed, f.justification = False, ""
+            else:
+                fs = rule.check_file(ctx, pf)
+            per_file.setdefault(pf.rel, {})[name] = [
+                dict(f.to_dict(), suppressed=False, justification="")
+                for f in fs]
+            findings.extend(fs)
     findings = _apply_suppressions(ctx, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return Report(findings=findings)
+    report = Report(findings=findings)
+    if cache_path:
+        _save_cache(cache_path, {
+            "meta": meta, "files": fkeys,
+            "findings": [f.to_dict() for f in report.findings],
+            "per_file": per_file})
+    return report
+
+
+# --------------------------------------------------------------- baseline
+def baseline_counts(report: Report) -> Dict[str, int]:
+    """Per-(rule, file) counts of the ACTIVE findings — the baseline
+    format.  Line- and message-insensitive so ordinary edits do not
+    churn it; only fixing or introducing findings moves the counts."""
+    counts: Dict[str, int] = {}
+    for f in report.active:
+        key = f"{f.rule}|{f.path}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_baseline(path: str, report: Report) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"tpulint_baseline": 1,
+                   "counts": baseline_counts(report)}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(report: Report, path: str) -> Tuple[List[Finding], int]:
+    """Split the active findings into (new, num_accepted): up to the
+    baseline's per-(rule, file) count of legacy findings is accepted
+    (earliest lines first); anything beyond it is NEW and fails CI."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    budget = dict(data.get("counts", {}))
+    new: List[Finding] = []
+    accepted = 0
+    for f in sorted(report.active, key=lambda x: (x.path, x.line, x.col)):
+        key = f"{f.rule}|{f.path}"
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            accepted += 1
+        else:
+            new.append(f)
+    return new, accepted
+
+
+# ----------------------------------------------------------- suppressions
+def iter_suppressions(package_dir: str):
+    """Yield (rel_path, comment_line, rules, justification) for every
+    tpulint disable comment in the package — the audit listing behind
+    `--list-suppressions`."""
+    ctx = LintContext(package_dir)
+    for pf in ctx.files:
+        for sups in pf.suppressions.values():
+            for sup in sups:
+                yield (pf.rel, sup.comment_line, sorted(sup.rules),
+                       sup.justification)

@@ -11,5 +11,9 @@ from . import collectives     # noqa: F401
 from . import config_doc      # noqa: F401
 from . import device_put      # noqa: F401
 from . import donate          # noqa: F401
+from . import donate_sharding  # noqa: F401
+from . import donated_reuse   # noqa: F401
 from . import dtype           # noqa: F401
 from . import host_sync       # noqa: F401
+from . import shape_taint     # noqa: F401
+from . import spmd            # noqa: F401

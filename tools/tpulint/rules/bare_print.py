@@ -32,18 +32,19 @@ class NoBarePrint(Rule):
     description = ("bare print() in the runtime package; route output "
                    "through utils.log or the event log")
 
-    def check(self, ctx: LintContext) -> List[Finding]:
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
         out: List[Finding] = []
-        for pf in ctx.files:
-            if pf.tree is None or pf.pkg_rel in WHITELIST:
-                continue
-            for node in ast.walk(pf.tree):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Name)
-                        and node.func.id == "print"):
-                    out.append(Finding(
-                        rule=self.name, path=pf.rel, line=node.lineno,
-                        col=node.col_offset,
-                        message="bare print() — route output through "
-                                "utils.log or the event log"))
+        if pf.tree is None or pf.pkg_rel in WHITELIST:
+            return out
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message="bare print() — route output through "
+                            "utils.log or the event log"))
         return out

@@ -35,25 +35,26 @@ class CollectiveDiscipline(Rule):
     description = ("lax collective outside parallel/ or distributed.py; "
                    "SPMD collective ordering must stay auditable")
 
-    def check(self, ctx: LintContext) -> List[Finding]:
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
         from ..callgraph import ModuleInfo
         out: List[Finding] = []
-        for pf in ctx.files:
-            if pf.tree is None or _is_allowed(pf.pkg_rel):
+        if pf.tree is None or _is_allowed(pf.pkg_rel):
+            return out
+        mi = ModuleInfo(pf, ctx.package_name)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
                 continue
-            mi = ModuleInfo(pf, ctx.package_name)
-            for node in ast.walk(pf.tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                dotted = mi.dotted_of(node.func) or ""
-                parts = dotted.rsplit(".", 1)
-                if len(parts) == 2 and parts[1] in COLLECTIVES \
-                        and parts[0] in ("jax.lax", "lax"):
-                    out.append(Finding(
-                        rule=self.name, path=pf.rel, line=node.lineno,
-                        col=node.col_offset,
-                        message=f"lax.{parts[1]} outside parallel/ or "
-                                "distributed.py — collectives live in the "
-                                "parallel layer so SPMD ordering stays "
-                                "auditable"))
+            dotted = mi.dotted_of(node.func) or ""
+            parts = dotted.rsplit(".", 1)
+            if len(parts) == 2 and parts[1] in COLLECTIVES \
+                    and parts[0] in ("jax.lax", "lax"):
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"lax.{parts[1]} outside parallel/ or "
+                            "distributed.py — collectives live in the "
+                            "parallel layer so SPMD ordering stays "
+                            "auditable"))
         return out

@@ -39,32 +39,33 @@ class NoDevicePutInLoop(Rule):
     description = ("jax.device_put/jnp.asarray inside a for/while body — "
                    "one H2D transfer per iteration serializes the loop")
 
-    def check(self, ctx: LintContext) -> List[Finding]:
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
         from ..callgraph import ModuleInfo
         out: List[Finding] = []
-        for pf in ctx.files:
-            if pf.tree is None or not _in_scope(pf.pkg_rel):
+        if pf.tree is None or not _in_scope(pf.pkg_rel):
+            return out
+        mi = ModuleInfo(pf, ctx.package_name)
+        seen = set()
+        for loop in ast.walk(pf.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
                 continue
-            mi = ModuleInfo(pf, ctx.package_name)
-            seen = set()
-            for loop in ast.walk(pf.tree):
-                if not isinstance(loop, (ast.For, ast.While)):
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
                     continue
-                for node in ast.walk(loop):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    dotted = mi.dotted_of(node.func) or ""
-                    if dotted not in _PUT_NAMES:
-                        continue
-                    key = (node.lineno, node.col_offset)
-                    if key in seen:  # nested loops walk the same call twice
-                        continue
-                    seen.add(key)
-                    out.append(Finding(
-                        rule=self.name, path=pf.rel, line=node.lineno,
-                        col=node.col_offset,
-                        message=f"{dotted} inside a {'for' if isinstance(loop, ast.For) else 'while'} "
-                                "body — host->device transfers in loops "
-                                "serialize on the dispatch queue; batch the "
-                                "data and transfer once outside the loop"))
+                dotted = mi.dotted_of(node.func) or ""
+                if dotted not in _PUT_NAMES:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:  # nested loops walk the same call twice
+                    continue
+                seen.add(key)
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"{dotted} inside a {'for' if isinstance(loop, ast.For) else 'while'} "
+                            "body — host->device transfers in loops "
+                            "serialize on the dispatch queue; batch the "
+                            "data and transfer once outside the loop"))
         return out

@@ -76,32 +76,33 @@ class DonateArgnums(Rule):
                    "donate them (donate_argnums) so XLA reuses the HBM "
                    "instead of allocating a fresh output buffer")
 
-    def check(self, ctx: LintContext) -> List[Finding]:
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
         from ..callgraph import ModuleInfo
         out: List[Finding] = []
-        for pf in ctx.files:
-            if pf.tree is None:
-                continue
-            mi = ModuleInfo(pf, ctx.package_name)
-            for node in ast.walk(pf.tree):
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    for dec in node.decorator_list:
-                        jit_call = self._as_jit_call(mi, dec)
-                        if jit_call is not None:
-                            out.extend(self._check_entry(
-                                pf, node, jit_call[0], jit_call[1]))
-                elif isinstance(node, ast.Call) \
-                        and self._is_jit_name(mi, node.func) and node.args:
-                    target = node.args[0]
-                    fn = None
-                    if isinstance(target, ast.Lambda):
-                        fn = target
-                    elif isinstance(target, ast.Name):
-                        fn = self._find_def(pf.tree, target.id)
-                    if fn is not None:
-                        out.extend(self._check_entry(pf, fn, node,
-                                                     node.lineno))
+        if pf.tree is None:
+            return out
+        mi = ModuleInfo(pf, ctx.package_name)
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    jit_call = self._as_jit_call(mi, dec)
+                    if jit_call is not None:
+                        out.extend(self._check_entry(
+                            pf, node, jit_call[0], jit_call[1]))
+            elif isinstance(node, ast.Call) \
+                    and self._is_jit_name(mi, node.func) and node.args:
+                target = node.args[0]
+                fn = None
+                if isinstance(target, ast.Lambda):
+                    fn = target
+                elif isinstance(target, ast.Name):
+                    fn = self._find_def(pf.tree, target.id)
+                if fn is not None:
+                    out.extend(self._check_entry(pf, fn, node,
+                                                 node.lineno))
         return out
 
     # ---- helpers -----------------------------------------------------

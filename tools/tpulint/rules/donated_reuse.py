@@ -1,0 +1,510 @@
+"""donated-buffer-reuse: reading a buffer after donating it to a jit.
+
+`donate_argnums` tells XLA the argument's HBM may be reused: after the
+donating call, the caller's array is DELETED — a later read raises
+"Array has been deleted", or worse, on some backends silently reads
+reused pages.  PR 5's `tpu_donate_buffers` introduced exactly this
+hazard class around the score/grad/hess buffers, and the donate-
+argnums rule only checks that entries donate — not that callers stop
+using what they donated.  This rule closes the caller side.
+
+Mechanics:
+
+* **donated entries** are collected package-wide: `@jax.jit(...,
+  donate_argnums=...)` decorators, `f = jax.jit(g, donate_argnums=...)`
+  assignments, and `self._fn = jax.jit(g, ...)` attributes — including
+  config-gated specs (`donate_argnums=_donate0` where `_donate0` is
+  `(0,) if cfg else ()`: donation then depends on runtime
+  configuration, and the caller must be safe when it is ON).  The
+  donating property propagates through rebinding — `self._grow_fn =
+  donated_entry if flag else plain_entry` and wrapper calls
+  (`RecompileDetector(self._grow_fn)`) keep the donated positions, and
+  import/re-export chains are followed.
+
+* **call sites**: inside every package function, a call resolving to a
+  donated entry consumes the bindings passed in donated positions
+  (names and `self.attr` attributes).  Simple aliases are tracked —
+  `gq, hq = g_k, h_k` followed by donating `gq` consumes `g_k` too.
+
+* a read of a consumed binding in a LATER statement (before it is
+  rebound) is a finding.  `scores = update(scores, ...)` — the
+  idiomatic donate-and-rebind — is clean: the statement's own target
+  rebinds the name.  Branches are analyzed separately and merged
+  conservatively (consumed in either branch counts).  Loop-carried
+  reuse (consume at the bottom of a body, read at the top of the next
+  iteration) is out of scope; the fixture tests pin the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintContext, Rule, register
+from .host_sync import _analyze
+
+
+@dataclass
+class DonateSpec:
+    """Donated parameter positions/names of one jitted entry."""
+    idxs: Set[int] = field(default_factory=set)
+    names: Set[str] = field(default_factory=set)
+    source: str = "jitted entry"
+
+    def merged(self, other: "DonateSpec") -> "DonateSpec":
+        return DonateSpec(self.idxs | other.idxs,
+                          self.names | other.names,
+                          self.source if self.idxs or self.names
+                          else other.source)
+
+    def __bool__(self) -> bool:
+        return bool(self.idxs or self.names)
+
+
+def _const_ints_strs(expr: ast.AST) -> Tuple[Set[int], Set[str]]:
+    idxs: Set[int] = set()
+    names: Set[str] = set()
+    for v in ast.walk(expr):
+        if isinstance(v, ast.Constant):
+            if isinstance(v.value, bool):
+                continue
+            if isinstance(v.value, int):
+                idxs.add(v.value)
+            elif isinstance(v.value, str):
+                names.add(v.value)
+    return idxs, names
+
+
+class _DonatedIndex:
+    """Package-wide map of donated entries: module names, class attrs."""
+
+    def __init__(self, ctx, index):
+        self.index = index
+        # (module_dotted, name) -> DonateSpec
+        self.by_name: Dict[Tuple[str, str], DonateSpec] = {}
+        # (module_dotted, class_name, attr) -> DonateSpec
+        self.by_attr: Dict[Tuple[str, str, str], DonateSpec] = {}
+        # def node id -> DonateSpec (decorated functions)
+        self.by_def: Dict[int, DonateSpec] = {}
+        for mi in index.modules.values():
+            if mi.pf.tree is not None:
+                self._scan_module(mi)
+        self._propagate()
+
+    # ---- collection ---------------------------------------------------
+    def _scan_module(self, mi) -> None:
+        for node in ast.walk(mi.pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    spec = self._spec_of_jit_call(mi, dec, node)
+                    if spec:
+                        spec.source = f"`{node.name}`"
+                        self.by_def[id(node)] = spec
+                        self.by_name[(mi.dotted, node.name)] = spec
+            elif isinstance(node, ast.Assign):
+                spec = self._spec_of_expr(mi, node.value)
+                if not spec:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        spec.source = f"`{t.id}`"
+                        self.by_name[(mi.dotted, t.id)] = spec
+                    else:
+                        attr = self._self_attr(t)
+                        cls = self._owning_class(mi, node)
+                        if attr and cls:
+                            spec.source = f"`self.{attr}`"
+                            key = (mi.dotted, cls, attr)
+                            self.by_attr[key] = spec.merged(
+                                self.by_attr.get(key, DonateSpec()))
+
+    def _owning_class(self, mi, node: ast.AST) -> Optional[str]:
+        for ci in mi.top_classes.values():
+            for n in ast.walk(ci.node):
+                if n is node:
+                    return ci.name
+        return None
+
+    @staticmethod
+    def _self_attr(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id in ("self", "cls"):
+            return t.attr
+        return None
+
+    def _spec_of_jit_call(self, mi, call: ast.AST,
+                          fn: Optional[ast.AST]) -> Optional[DonateSpec]:
+        """Donate spec carried by a jit/partial(jit) call expression."""
+        if not isinstance(call, ast.Call):
+            return None
+        dotted = mi.dotted_of(call.func)
+        is_jit = dotted in ("jax.jit", "jit")
+        if not is_jit and dotted in ("functools.partial", "partial") \
+                and call.args:
+            is_jit = mi.dotted_of(call.args[0]) in ("jax.jit", "jit")
+        if not is_jit:
+            return None
+        spec = DonateSpec()
+        for kw in call.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            expr = kw.value
+            if isinstance(expr, ast.Name):
+                # config-gated: _donate0 = (0,) if cfg else ()
+                for e in self._name_assignments(mi, expr.id):
+                    i, s = _const_ints_strs(e)
+                    spec.idxs |= i
+                    spec.names |= s
+            else:
+                i, s = _const_ints_strs(expr)
+                spec.idxs |= i
+                spec.names |= s
+        if spec and fn is None and call.args:
+            fn = self._jit_target(mi, call.args[0])
+        if spec and fn is not None:
+            self._names_to_idxs(spec, fn)
+        return spec if spec else None
+
+    def _jit_target(self, mi, target: ast.AST) -> Optional[ast.AST]:
+        if isinstance(target, ast.Lambda):
+            return target
+        if isinstance(target, ast.Name):
+            for fid in self.index.resolve_name(mi, target.id):
+                return self.index.func(fid).node
+        return None
+
+    @staticmethod
+    def _names_to_idxs(spec: DonateSpec, fn: ast.AST) -> None:
+        a = fn.args
+        params = [p.arg for p in getattr(a, "posonlyargs", [])]
+        params += [p.arg for p in a.args]
+        for n in list(spec.names):
+            if n in params:
+                spec.idxs.add(params.index(n))
+                spec.names.discard(n)
+
+    def _name_assignments(self, mi, name: str) -> List[ast.AST]:
+        out = []
+        for node in ast.walk(mi.pf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        out.append(node.value)
+        return out
+
+    def _spec_of_expr(self, mi, expr: ast.AST,
+                      cls: Optional[str] = None) -> DonateSpec:
+        """Donated positions an assignment RHS may carry: a direct jit
+        call, references to donated names/attrs, wrapper-call args."""
+        spec = DonateSpec()
+        direct = self._spec_of_jit_call(mi, expr, None)
+        if direct:
+            return direct
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name):
+                s = self.resolve_name_spec(mi, node.id)
+                if s:
+                    spec = spec.merged(s)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in ("self", "cls") and cls:
+                s = self.by_attr.get((mi.dotted, cls, node.attr))
+                if s:
+                    spec = spec.merged(s)
+        return spec
+
+    def resolve_name_spec(self, mi, name: str,
+                          _seen: Optional[Set[Tuple[str, str]]] = None
+                          ) -> Optional[DonateSpec]:
+        """Follow import/re-export chains to a donated module name."""
+        _seen = _seen or set()
+        key = (mi.dotted, name)
+        if key in _seen:
+            return None
+        _seen.add(key)
+        if key in self.by_name:
+            return self.by_name[key]
+        imp = mi.imports.get(name)
+        if imp and imp[1]:
+            tgt = self.index.modules.get(imp[0])
+            if tgt is not None:
+                return self.resolve_name_spec(tgt, imp[1], _seen)
+        return None
+
+    def _propagate(self) -> None:
+        """self.attr = <expr referencing a donated entry> — fixpoint so
+        wrapper rebinds (RecompileDetector(self._grow_fn)) keep it."""
+        for _ in range(4):
+            changed = False
+            for mi in self.index.modules.values():
+                if mi.pf.tree is None:
+                    continue
+                for ci in mi.top_classes.values():
+                    for node in ast.walk(ci.node):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        for t in node.targets:
+                            attr = self._self_attr(t)
+                            if attr is None:
+                                continue
+                            spec = self._spec_of_expr(mi, node.value,
+                                                      ci.name)
+                            if not spec:
+                                continue
+                            key = (mi.dotted, ci.name, attr)
+                            cur = self.by_attr.get(key)
+                            new = spec.merged(cur or DonateSpec())
+                            if cur is None or new.idxs != cur.idxs \
+                                    or new.names != cur.names:
+                                self.by_attr[key] = new
+                                changed = True
+            if not changed:
+                break
+
+    # ---- call-site lookup --------------------------------------------
+    def spec_for_call(self, mi, cls: Optional[str],
+                      func: ast.AST) -> Optional[DonateSpec]:
+        if isinstance(func, ast.Name):
+            return self.resolve_name_spec(mi, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) \
+                    and func.value.id in ("self", "cls"):
+                if cls is not None:
+                    s = self.by_attr.get((mi.dotted, cls, func.attr))
+                    if s:
+                        return s
+                    # inherited attributes (RF(GBDT) uses the base's
+                    # _score_update_fn)
+                    ci = mi.top_classes.get(cls)
+                    for base in (ci.bases if ci else []):
+                        s = self.by_attr.get((base.module.dotted,
+                                              base.name, func.attr))
+                        if s:
+                            return s
+                return None
+            if isinstance(func.value, ast.Name):
+                imp = mi.imports.get(func.value.id)
+                if imp and imp[1] is None:
+                    tgt = self.index.modules.get(imp[0])
+                    if tgt is not None:
+                        return self.resolve_name_spec(tgt, func.attr)
+        return None
+
+
+# ---------------------------------------------------------------- walker
+def _binding_key(expr: ast.AST) -> Optional[str]:
+    """Name or self.attr expression -> binding key string."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return f"self.{expr.attr}"
+    return None
+
+
+class _State:
+    """consumed binding -> (entry description, donate line);
+    alias groups are shared sets of binding keys."""
+
+    def __init__(self):
+        self.consumed: Dict[str, Tuple[str, int]] = {}
+        self.alias: Dict[str, Set[str]] = {}
+
+    def copy(self) -> "_State":
+        s = _State()
+        s.consumed = dict(self.consumed)
+        s.alias = {k: set(v) for k, v in self.alias.items()}
+        return s
+
+    def merge(self, other: "_State") -> None:
+        self.consumed.update(other.consumed)
+        for k, v in other.alias.items():
+            self.alias.setdefault(k, set()).update(v)
+
+    def group(self, key: str) -> Set[str]:
+        return self.alias.get(key, set()) | {key}
+
+    def consume(self, key: str, why: Tuple[str, int]) -> None:
+        for k in self.group(key):
+            self.consumed[k] = why
+
+    def rebind(self, key: str) -> None:
+        self.consumed.pop(key, None)
+        grp = self.alias.pop(key, None)
+        if grp is not None:
+            for other in grp:
+                self.alias.get(other, set()).discard(key)
+
+    def record_alias(self, a: str, b: str) -> None:
+        grp = self.alias.setdefault(a, set())
+        grp.add(b)
+        self.alias.setdefault(b, set()).add(a)
+
+
+@register
+class DonatedBufferReuse(Rule):
+    name = "donated-buffer-reuse"
+    description = ("a binding passed in a donated position of a jitted "
+                   "entry is read again before being rebound — donation "
+                   "deletes the caller's buffer")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        index, _ = _analyze(ctx)
+        donated = _DonatedIndex(ctx, index)
+        out: List[Finding] = []
+        for mi in index.modules.values():
+            if mi.pf.tree is None:
+                continue
+            for fi in list(mi.top_funcs.values()):
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                self._check_function(mi, None, fi.node, donated, out)
+            for ci in mi.top_classes.values():
+                for m in ci.methods.values():
+                    self._check_function(mi, ci.name, m.node, donated,
+                                         out)
+        return out
+
+    # ---- one function -------------------------------------------------
+    def _check_function(self, mi, cls: Optional[str], fn: ast.AST,
+                        donated: _DonatedIndex,
+                        out: List[Finding]) -> None:
+        state = _State()
+        self._walk_body(mi, cls, list(fn.body), state, donated, out)
+
+    def _walk_body(self, mi, cls, body: List[ast.stmt], state: _State,
+                   donated: _DonatedIndex, out: List[Finding]) -> None:
+        for stmt in body:
+            self._walk_stmt(mi, cls, stmt, state, donated, out)
+
+    def _walk_stmt(self, mi, cls, stmt: ast.stmt, state: _State,
+                   donated: _DonatedIndex, out: List[Finding]) -> None:
+        if isinstance(stmt, ast.If):
+            self._check_reads(mi, stmt.test, state, out)
+            s1, s2 = state.copy(), state.copy()
+            self._walk_body(mi, cls, stmt.body, s1, donated, out)
+            self._walk_body(mi, cls, stmt.orelse, s2, donated, out)
+            state.consumed = {}
+            state.alias = {}
+            state.merge(s1)
+            state.merge(s2)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_reads(mi, stmt.iter, state, out)
+            self._apply_targets(stmt.target, state)
+            self._walk_body(mi, cls, stmt.body, state, donated, out)
+            self._walk_body(mi, cls, stmt.orelse, state, donated, out)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_reads(mi, stmt.test, state, out)
+            self._walk_body(mi, cls, stmt.body, state, donated, out)
+            self._walk_body(mi, cls, stmt.orelse, state, donated, out)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(mi, item.context_expr, state, out)
+                if item.optional_vars is not None:
+                    self._apply_targets(item.optional_vars, state)
+            self._walk_body(mi, cls, stmt.body, state, donated, out)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(mi, cls, stmt.body, state, donated, out)
+            for h in stmt.handlers:
+                self._walk_body(mi, cls, h.body, state, donated, out)
+            self._walk_body(mi, cls, stmt.orelse, state, donated, out)
+            self._walk_body(mi, cls, stmt.finalbody, state, donated, out)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are checked as their own functions
+
+        # simple statement: reads -> donations -> (re)bindings
+        self._check_reads(mi, stmt, state, out)
+        for call in self._calls_in(stmt):
+            spec = donated.spec_for_call(mi, cls, call.func)
+            if spec is None or not spec:
+                continue
+            for key in self._donated_arg_keys(call, spec):
+                state.consume(key, (spec.source, call.lineno))
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._apply_targets(t, state)
+            self._record_aliases(stmt, state)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            self._apply_targets(stmt.target, state)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                key = _binding_key(t)
+                if key:
+                    state.rebind(key)
+
+    # ---- helpers ------------------------------------------------------
+    @staticmethod
+    def _calls_in(stmt: ast.stmt):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+    @staticmethod
+    def _donated_arg_keys(call: ast.Call, spec: DonateSpec):
+        for i, a in enumerate(call.args):
+            if i in spec.idxs and not isinstance(a, ast.Starred):
+                key = _binding_key(a)
+                if key:
+                    yield key
+        for kw in call.keywords:
+            if kw.arg and kw.arg in spec.names:
+                key = _binding_key(kw.value)
+                if key:
+                    yield key
+
+    def _check_reads(self, mi, node: ast.AST, state: _State,
+                     out: List[Finding]) -> None:
+        if not state.consumed:
+            return
+        pf = mi.pf
+        for n in ast.walk(node):
+            key = None
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                key = n.id
+            elif isinstance(n, ast.Attribute) \
+                    and isinstance(n.ctx, ast.Load):
+                key = _binding_key(n)
+            if key is None or key not in state.consumed:
+                continue
+            source, line = state.consumed[key]
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=n.lineno,
+                col=n.col_offset,
+                message=f"`{key}` was passed in a donated position of "
+                        f"{source} at line {line}; donation deletes the "
+                        "caller's buffer, so this read can raise 'Array "
+                        "has been deleted' (or read reused pages) — "
+                        "move the read before the donating call, or "
+                        "rebind the name first"))
+            # one finding per consumption is enough
+            state.rebind(key)
+
+    def _apply_targets(self, target: ast.AST, state: _State) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                key = _binding_key(n)
+                if key and isinstance(getattr(n, "ctx", None),
+                                      (ast.Store, ast.Del)):
+                    state.rebind(key)
+
+    def _record_aliases(self, stmt: ast.Assign, state: _State) -> None:
+        for t in stmt.targets:
+            if isinstance(t, (ast.Tuple, ast.List)) \
+                    and isinstance(stmt.value, (ast.Tuple, ast.List)) \
+                    and len(t.elts) == len(stmt.value.elts):
+                for te, ve in zip(t.elts, stmt.value.elts):
+                    tk, vk = _binding_key(te), _binding_key(ve)
+                    if tk and vk:
+                        state.record_alias(tk, vk)
+            else:
+                tk, vk = _binding_key(t), _binding_key(stmt.value)
+                if tk and vk:
+                    state.record_alias(tk, vk)

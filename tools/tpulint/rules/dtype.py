@@ -41,33 +41,34 @@ class ExplicitDtype(Rule):
     description = ("jnp array constructor without an explicit dtype in "
                    "device code (weak-type promotion / recompile hazard)")
 
-    def check(self, ctx: LintContext) -> List[Finding]:
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
         from ..callgraph import ModuleInfo
         out: List[Finding] = []
-        for pf in ctx.files:
-            if pf.tree is None or not _in_scope(pf.pkg_rel):
+        if pf.tree is None or not _in_scope(pf.pkg_rel):
+            return out
+        mi = ModuleInfo(pf, ctx.package_name)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
                 continue
-            mi = ModuleInfo(pf, ctx.package_name)
-            for node in ast.walk(pf.tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                dotted = mi.dotted_of(node.func) or ""
-                parts = dotted.rsplit(".", 1)
-                if len(parts) != 2 or parts[0] not in ("jax.numpy", "jnp"):
-                    continue
-                fn = parts[1]
-                if fn not in CONSTRUCTORS:
-                    continue
-                if any(kw.arg == "dtype" for kw in node.keywords):
-                    continue
-                n_pos = len([a for a in node.args
-                             if not isinstance(a, ast.Starred)])
-                if n_pos >= CONSTRUCTORS[fn] and n_pos == len(node.args):
-                    continue  # positional dtype present
-                out.append(Finding(
-                    rule=self.name, path=pf.rel, line=node.lineno,
-                    col=node.col_offset,
-                    message=f"jnp.{fn} without an explicit dtype — "
-                            "weak-typed literals promote silently and "
-                            "can flip the jit cache key"))
+            dotted = mi.dotted_of(node.func) or ""
+            parts = dotted.rsplit(".", 1)
+            if len(parts) != 2 or parts[0] not in ("jax.numpy", "jnp"):
+                continue
+            fn = parts[1]
+            if fn not in CONSTRUCTORS:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            n_pos = len([a for a in node.args
+                         if not isinstance(a, ast.Starred)])
+            if n_pos >= CONSTRUCTORS[fn] and n_pos == len(node.args):
+                continue  # positional dtype present
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=f"jnp.{fn} without an explicit dtype — "
+                        "weak-typed literals promote silently and "
+                        "can flip the jit cache key"))
         return out

@@ -1,0 +1,242 @@
+"""no-dynamic-shape-in-jit: data-dependent shapes inside jit scope.
+
+XLA programs have static shapes: an op whose OUTPUT shape depends on
+the VALUES of a traced array either fails to trace
+(`jnp.nonzero(mask)` raises ConcretizationTypeError) or — the silent
+form — forces a fresh compile for every distinct value when the shape
+rides a Python scalar argument.  These are the recompile generators
+PR 2's RecompileDetector only catches at runtime, after the multi-
+second stall already happened.  This rule flags them at lint time,
+over the same jit-reachable call graph + parameter taint the host-sync
+rules use (callgraph.py, v2: methods and dispatch tables included).
+
+Flagged (all only when the offending value is TRACED):
+
+* `jnp.nonzero` / `flatnonzero` / `argwhere` / `unique*` without a
+  `size=` keyword — the output length is data-dependent; jax requires
+  `size=` (+ `fill_value`) inside jit;
+* one-argument `jnp.where(mask)` — same contract as nonzero; the
+  three-argument `jnp.where(mask, a, b)` select is the static-shape
+  form and stays clean;
+* boolean-mask indexing `x[mask]` — the canonical silent one: works
+  in eager NumPy, dies under jit.  Masks are recognized syntactically
+  (a comparison, a logical op, `isnan`/`isfinite`-family calls, or a
+  name assigned from one);
+* `jnp.repeat` / `.repeat()` with a traced repeats argument and no
+  `total_repeat_length=`;
+* a traced SHAPE argument to `reshape` / `zeros` / `ones` / `full` /
+  `empty` / `arange` / `broadcast_to` / `tile` / `eye` / `linspace` —
+  shapes must be Python values at trace time; deriving one from a
+  traced array is a trace error, and deriving it from a non-static
+  Python parameter recompiles per distinct value (mark the parameter
+  `static_argnames` if it is configuration).
+
+`x.reshape(-1)` and friends on static geometry stay clean: `.shape`
+access is a static value in the taint model, and constants never
+taint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, LintContext, Rule, register
+from .host_sync import _for_each_function
+
+_NP_MODULES = ("jax.numpy", "jnp", "numpy", "np")
+
+# value -> data-dependent output length unless size= is given
+DYN_LEN_FUNCS = {"nonzero", "flatnonzero", "argwhere", "unique",
+                 "unique_all", "unique_counts", "unique_inverse",
+                 "unique_values"}
+
+# constructor/reshape family: which call arguments carry a shape
+# (None = every positional argument, e.g. arange's start/stop/step)
+SHAPE_ARG_FUNCS = {
+    "reshape": [1], "zeros": [0], "ones": [0], "empty": [0],
+    "full": [0], "arange": None, "broadcast_to": [1], "tile": [1],
+    "eye": [0, 1], "linspace": [2],
+}
+
+# calls whose result is a boolean mask
+_BOOL_CALLS = {"isnan", "isfinite", "isinf", "isneginf", "isposinf",
+               "logical_and", "logical_or", "logical_not", "logical_xor",
+               "greater", "greater_equal", "less", "less_equal",
+               "equal", "not_equal", "isin", "isclose"}
+
+
+def _np_func(mi, call: ast.Call) -> Optional[str]:
+    dotted = mi.dotted_of(call.func) or ""
+    parts = dotted.rsplit(".", 1)
+    if len(parts) == 2 and parts[0] in _NP_MODULES:
+        return parts[1]
+    return None
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+class _BoolNames:
+    """Names assigned from boolean-mask expressions, keyed by their
+    owning lexical scope (two unrelated `pos` bindings in different
+    nested functions stay distinct).  Not flow-sensitive — a linter
+    approximation pinned by the fixture tests."""
+
+    def __init__(self, mi, walker):
+        self.mi = mi
+        self.walker = walker
+        self.keys: Set[tuple] = set()
+        for _ in range(4):
+            before = len(self.keys)
+            for node in ast.walk(walker.fi.node):
+                if isinstance(node, ast.Assign) \
+                        and self.is_bool_expr(node.value):
+                    scope = walker.node_scope.get(id(node))
+                    if scope is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            owner = scope.owner_of(t.id) or scope
+                            self.keys.add((id(owner), t.id))
+            if len(self.keys) == before:
+                break
+
+    def _name_is_bool(self, e: ast.Name) -> bool:
+        scope = self.walker.node_scope.get(id(e))
+        if scope is None:
+            return False
+        owner = scope.owner_of(e.id)
+        return owner is not None and (id(owner), e.id) in self.keys
+
+    def is_bool_expr(self, e: Optional[ast.AST]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Compare):
+            return not all(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in e.ops)
+        if isinstance(e, ast.BoolOp):
+            return any(self.is_bool_expr(v) for v in e.values)
+        if isinstance(e, ast.UnaryOp):
+            return isinstance(e.op, (ast.Invert, ast.Not)) \
+                and self.is_bool_expr(e.operand)
+        if isinstance(e, ast.BinOp) and isinstance(e.op, (ast.BitAnd,
+                                                          ast.BitOr,
+                                                          ast.BitXor)):
+            return self.is_bool_expr(e.left) or self.is_bool_expr(e.right)
+        if isinstance(e, ast.Name):
+            return self._name_is_bool(e)
+        if isinstance(e, ast.Call):
+            fn = _np_func(self.mi, e)
+            if fn in _BOOL_CALLS:
+                return True
+            if isinstance(e.func, ast.Attribute) \
+                    and e.func.attr == "astype" and e.args:
+                a0 = e.args[0]
+                return (isinstance(a0, ast.Name) and a0.id == "bool") \
+                    or (isinstance(a0, ast.Constant) and a0.value == "bool")
+        return False
+
+
+@register
+class NoDynamicShapeInJit(Rule):
+    name = "no-dynamic-shape-in-jit"
+    description = ("data-dependent output shape inside jit-reachable "
+                   "code (nonzero/unique/1-arg where without size=, "
+                   "boolean-mask indexing, traced shape arguments) — a "
+                   "trace error or a silent recompile per value")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(pf, node, fi, msg):
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=msg + f" (in jit-reachable `{fi.qualname}`)"))
+
+        def visit(fi, walker):
+            pf = fi.module.pf
+            mi = fi.module
+            bools = _BoolNames(mi, walker)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    self._check_call(pf, mi, node, fi, walker, flag)
+                elif isinstance(node, ast.Subscript):
+                    self._check_mask_index(pf, node, fi, walker, bools,
+                                           flag)
+
+        _for_each_function(ctx, visit)
+        return out
+
+    # ---- calls --------------------------------------------------------
+    def _check_call(self, pf, mi, node: ast.Call, fi, walker, flag):
+        fn = _np_func(mi, node)
+        args = list(node.args)
+        if fn in DYN_LEN_FUNCS:
+            if args and walker.taint(args[0]) and not _has_kw(node,
+                                                              "size"):
+                flag(pf, node, fi,
+                     f"jnp.{fn} on a traced value without size= has a "
+                     "data-dependent output shape — pass size= (and "
+                     "fill_value=) or restructure with a mask")
+            return
+        if fn == "where":
+            if len(args) == 1 and not node.keywords \
+                    and walker.taint(args[0]):
+                flag(pf, node, fi,
+                     "one-argument jnp.where on a traced mask has a "
+                     "data-dependent output shape — use the three-"
+                     "argument jnp.where(mask, a, b) or pass size=")
+            return
+        if fn == "repeat" or (isinstance(node.func, ast.Attribute)
+                              and node.func.attr == "repeat"):
+            reps = None
+            if fn == "repeat" and len(args) >= 2:
+                reps = args[1]
+            elif fn is None and args:  # method form x.repeat(r)
+                reps = args[0]
+            for kw in node.keywords:
+                if kw.arg == "repeats":
+                    reps = kw.value
+            if reps is not None and walker.taint(reps) \
+                    and not _has_kw(node, "total_repeat_length"):
+                flag(pf, node, fi,
+                     "repeat with traced repeats has a data-dependent "
+                     "output shape — pass total_repeat_length= or make "
+                     "the repeats static")
+            return
+        # traced shape arguments (module functions and .reshape method)
+        shape_args: List[ast.AST] = []
+        if fn in SHAPE_ARG_FUNCS:
+            idxs = SHAPE_ARG_FUNCS[fn]
+            shape_args = args if idxs is None else [
+                args[i] for i in idxs if i < len(args)]
+            shape_args += [kw.value for kw in node.keywords
+                           if kw.arg in ("shape", "num")]
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "reshape":
+            shape_args = args
+        for sa in shape_args:
+            if walker.taint(sa):
+                flag(pf, node, fi,
+                     "traced value used as a shape argument — shapes "
+                     "are static under jit: derive it from .shape, or "
+                     "mark the parameter static_argnames if it is "
+                     "configuration (a Python scalar here recompiles "
+                     "per distinct value)")
+                break
+
+    # ---- boolean-mask indexing ---------------------------------------
+    def _check_mask_index(self, pf, node: ast.Subscript, fi, walker,
+                          bools: _BoolNames, flag):
+        idx = node.slice
+        cands = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        for c in cands:
+            if bools.is_bool_expr(c) and walker.taint(c):
+                flag(pf, node, fi,
+                     "boolean-mask indexing on a traced mask has a "
+                     "data-dependent output shape — use "
+                     "jnp.where(mask, a, b) or masked reductions")
+                return
