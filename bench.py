@@ -232,6 +232,124 @@ def _predict_throughput(booster, X):
     return out
 
 
+_MULTICHIP_CHILD = r"""
+import os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import jax
+if os.environ.get("BENCH_MULTICHIP_FORCE_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import lightgbm_tpu as lgb
+
+work = os.environ["BENCH_MULTICHIP_DIR"]
+rng = np.random.RandomState(11)
+X = rng.rand(1024, 5)
+y = (3 * (X[:, 0] - 0.5) + X[:, 1] * X[:, 2]).astype(np.float64)
+params = {
+    "objective": "regression", "num_leaves": 7, "verbosity": -1,
+    "min_data_in_leaf": 5, "learning_rate": 0.2,
+    "tree_learner": "data", "tpu_growth_strategy": "wave",
+    "metrics_dir": os.path.join(work, "metrics"),
+    "checkpoint_dir": os.path.join(work, "ckpt"), "checkpoint_freq": 1,
+    "auto_degrade": True,
+    "stall_floor_s": float(os.environ.get("BENCH_STALL_FLOOR_S", "30")),
+    "stall_factor": 10.0,
+}
+b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+assert np.isfinite(b.predict(X[:64])).all()
+print("MULTICHIP_TRAIN_OK", b.current_iteration(), flush=True)
+"""
+
+
+def multichip_main(n_devices: int) -> int:
+    """Guarded multi-chip smoke runner (ISSUE 7): train a short
+    sharded-wave run over an `n_devices` mesh UNDER the stall watchdog,
+    walking the degradation ladder across relaunches when an attempt
+    hangs.  Prints one MULTICHIP-style JSON line that is
+    self-explaining on failure: `stall_diagnosis` carries the wedged
+    attempt's stack + knob fingerprint and `degraded_knobs` the ladder
+    steps a recovered run needed — the two fields MULTICHIP_r05 (rc=124,
+    one stderr line) did not have.
+
+    Fault injection for self-tests / driver drills:
+    `BENCH_MULTICHIP_FAULT=hang@3` wedges attempt 0 at iteration 3.
+    """
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from lightgbm_tpu.reliability.guard import (DEGRADE_LADDER,
+                                                degraded_knobs,
+                                                stall_file_path)
+    from lightgbm_tpu.reliability.supervisor import classify_returncode
+
+    timeout = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT", "600"))
+    env = dict(os.environ)
+    env["BENCH_REPO"] = os.path.dirname(os.path.abspath(__file__))
+    # self-provision the mesh (as __graft_entry__.dryrun_multichip does):
+    # when this host has fewer devices, the children run on a virtual
+    # n-device CPU platform
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        capture_output=True, text=True, timeout=300, env=env)
+    have = int(probe.stdout.strip() or 0) if probe.returncode == 0 else 0
+    if have < n_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_devices}").strip()
+        env["BENCH_MULTICHIP_FORCE_CPU"] = "1"
+    if os.environ.get("BENCH_MULTICHIP_FAULT"):
+        env["LGBM_TPU_FAULT"] = os.environ["BENCH_MULTICHIP_FAULT"]
+
+    work = tempfile.mkdtemp(prefix="lgbtpu_multichip")
+    metrics = os.path.join(work, "metrics")
+    out = {"metric": "multichip_guarded", "n_devices": int(n_devices),
+           "rc": None, "ok": False, "classification": None,
+           "attempts": 0, "stall_diagnosis": None, "degraded_knobs": [],
+           "tail": ""}
+    try:
+        env["BENCH_MULTICHIP_DIR"] = work
+        script = os.path.join(work, "child.py")
+        with open(script, "w") as f:
+            f.write(_MULTICHIP_CHILD)
+        # one first try + one relaunch per ladder rung: a run that still
+        # hangs with every risky knob off is a real bug, not a knob
+        for attempt in range(1 + len(DEGRADE_LADDER)):
+            out["attempts"] = attempt + 1
+            env["LGBM_TPU_FAULT_ATTEMPT"] = str(attempt)
+            try:
+                res = subprocess.run(
+                    [sys.executable, script], capture_output=True,
+                    text=True, timeout=timeout, env=env)
+                rc = res.returncode
+                out["tail"] = ((res.stdout or "") + (res.stderr or ""))[-2000:]
+            except subprocess.TimeoutExpired as e:
+                rc = 124
+                out["tail"] = (str(e.stdout or "") + str(e.stderr or ""))[-2000:]
+            out["rc"] = rc
+            out["classification"] = classify_returncode(rc)
+            if out["stall_diagnosis"] is None:
+                spath = stall_file_path(metrics, 0)
+                if os.path.exists(spath):
+                    try:
+                        out["stall_diagnosis"] = json.load(open(spath))
+                    except (OSError, ValueError):
+                        pass
+            if rc == 0:
+                out["ok"] = True
+                break
+            if out["classification"] != "hang":
+                break  # a crash is not the ladder's problem
+        out["degraded_knobs"] = degraded_knobs(metrics)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
 def main():
     backend_fallback = _ensure_jax_backend()
     import jax
@@ -444,4 +562,7 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(diff_main(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--multichip":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        sys.exit(multichip_main(n))
     main()

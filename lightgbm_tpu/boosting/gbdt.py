@@ -1173,6 +1173,7 @@ class GBDT:
         K = self.num_tree_per_iteration
         if faults.active():
             faults.maybe_crash(self.num_init_iteration_ + self.iter_)
+            faults.maybe_hang(self.num_init_iteration_ + self.iter_)
         # sentinel flags fetched for the previous iteration are stale now
         self._finite_cache = None
         init_scores = [0.0] * K
@@ -1248,6 +1249,12 @@ class GBDT:
                             and self.growth_strategy == "wave"
                             and self.grow_params.quant_bins > 0):
                         grow_kw["quant_scales"] = qscales
+                    if faults.active():
+                        # one rank wedging HERE leaves its peers blocked
+                        # inside the histogram psum — the live-but-hung
+                        # shape the stall watchdog exists for
+                        faults.maybe_collective_stall(
+                            self.num_init_iteration_ + self.iter_)
                     out = self._grow_fn(
                         self.binned_dev, gq, hq, bag_mask,
                         self._col_mask(), self.meta, self.grow_params,

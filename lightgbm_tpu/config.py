@@ -190,6 +190,17 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     ("retry_backoff", "float", 1.0, ("retry_backoff_base",)),
     # non-finite sentinel: check train scores every N iterations (0 = off)
     ("nonfinite_check_freq", "int", 10, ("non_finite_check_freq",)),
+    # stall watchdog (reliability/guard.py): trip when no boosting
+    # iteration completes within max(stall_floor_s, stall_factor *
+    # rolling-median iteration time); 0 disables the watchdog.  Active
+    # only when metrics_dir (or a supervisor heartbeat file) gives the
+    # diagnosis somewhere to land.
+    ("stall_floor_s", "float", 120.0, ("stall_timeout_floor",)),
+    ("stall_factor", "float", 20.0, ("stall_timeout_factor",)),
+    # graceful degradation: after a hang-classified failure, relaunch
+    # from the last checkpoint with the next risky knob disabled
+    # (donation -> compile cache -> async_host_io -> device_eval)
+    ("auto_degrade", "bool", False, ("auto_degradation",)),
     # --- observability (docs/Observability.md) ---
     # structured JSONL event log: one rank-tagged event per iteration
     ("metrics_dir", "str", "", ("telemetry_dir", "events_dir")),

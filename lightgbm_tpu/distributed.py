@@ -140,6 +140,15 @@ for k, v in spec.get("env", {}).items():
 # (retried clusters bump the attempt so one-shot faults don't re-fire)
 os.environ["LGBM_TPU_FAULT_SELF_RANK"] = str(rank)
 os.environ["LGBM_TPU_FAULT_ATTEMPT"] = str(spec.get("attempt", 0))
+# stall detection (reliability/guard.py): the engine's RunGuard touches
+# this file once per boosting iteration; the supervising parent polls
+# its mtime to catch live-but-hung ranks, and the guard's stall
+# diagnosis lands next to it when the run has no metrics_dir
+if spec.get("heartbeat_dir"):
+    os.makedirs(spec["heartbeat_dir"], exist_ok=True)
+    os.environ["LGBM_TPU_HEARTBEAT_FILE"] = os.path.join(
+        spec["heartbeat_dir"], f"heartbeat-rank{rank}")
+    os.environ["LGBM_TPU_STALL_DIR"] = spec["heartbeat_dir"]
 import jax
 if spec.get("force_cpu"):
     jax.config.update("jax_platforms", "cpu")
@@ -149,6 +158,12 @@ jax.distributed.initialize(coordinator_address=spec["coordinator"],
 sys.path.insert(0, spec["repo"])
 import numpy as np
 import lightgbm_tpu as lgb
+from lightgbm_tpu.observability import install_sigterm_flush
+from lightgbm_tpu.reliability import faults
+# kill -USR1 <pid>: on-demand all-thread stack dump from a live worker
+faults.register_stack_dump_signal()
+# a supervisor SIGTERM flushes queued events/checkpoints before exit
+install_sigterm_flush()
 
 with open(spec["data"], "rb") as f:
     payload = pickle.load(f)
@@ -185,7 +200,8 @@ def train_distributed(params: Dict[str, Any], data, label=None, *,
                       force_cpu: bool = False, timeout: int = 900,
                       max_retries: int = 0, checkpoint_dir: Optional[str] = None,
                       checkpoint_freq: int = 0, retry_backoff: float = 1.0,
-                      poll_interval: float = 0.25):
+                      poll_interval: float = 0.25,
+                      stall_timeout: Optional[float] = None):
     """Spawn `num_machines` local SPMD workers, train tree_learner=data
     across their combined devices, and return the trained Booster (all
     workers produce identical models; rank 0's is returned).
@@ -215,7 +231,7 @@ def train_distributed(params: Dict[str, Any], data, label=None, *,
             num_boost_round, num_machines, worker_env, force_cpu, timeout,
             Booster, max_retries=max_retries, checkpoint_dir=checkpoint_dir,
             checkpoint_freq=checkpoint_freq, retry_backoff=retry_backoff,
-            poll_interval=poll_interval)
+            poll_interval=poll_interval, stall_timeout=stall_timeout)
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
@@ -224,8 +240,22 @@ def _train_distributed_in(work, params, data, label, weight, group,
                           num_boost_round, num_machines, worker_env,
                           force_cpu, timeout, Booster, *, max_retries=0,
                           checkpoint_dir=None, checkpoint_freq=0,
-                          retry_backoff=1.0, poll_interval=0.25):
+                          retry_backoff=1.0, poll_interval=0.25,
+                          stall_timeout=None):
+    from .config import Config
+    from .reliability.guard import (disabled_value, next_degradation,
+                                    _LADDER_KNOBS)
     from .reliability.supervisor import supervise
+
+    run_cfg = Config(dict(params))
+    auto_degrade = bool(run_cfg.auto_degrade)
+    if stall_timeout is None:
+        # mtime-staleness backstop: must outlast the worker guard's
+        # first-compile deadline, or the parent would kill a cluster
+        # that is legitimately still compiling its device program
+        stall_timeout = (max(10.0 * run_cfg.stall_floor_s, 600.0)
+                         if run_cfg.stall_floor_s > 0 else 0.0)
+    degraded_knobs: List[str] = []
 
     data_path = os.path.join(work, "data.pkl")
     with open(data_path, "wb") as f:
@@ -264,20 +294,29 @@ def _train_distributed_in(work, params, data, label, weight, group,
                         f"{params['metrics_dir']}: {e}")
 
     last_failure = "no workers launched"
+    # the parent owns the degradation ladder in distributed mode: the
+    # workers must not ALSO consume stall files and double-degrade
+    worker_params = dict(params)
+    worker_params["auto_degrade"] = False
     for attempt in range(max_retries + 1):
         # fresh coordinator port per attempt: the previous coordinator
         # process is gone and its port may linger in TIME_WAIT
         port = _free_port()
+        # per-attempt heartbeat dir: rank heartbeats + (when the run has
+        # no metrics_dir) the stall diagnoses land here
+        hb_dir = os.path.join(work, f"hb_a{attempt}")
+        os.makedirs(hb_dir, exist_ok=True)
         spec = {"coordinator": f"localhost:{port}",
                 "num_machines": int(num_machines),
-                "params": dict(params),
+                "params": dict(worker_params),
                 "num_boost_round": int(num_boost_round),
                 "data": data_path, "model_out": model_out,
                 "repo": os.path.dirname(
                     os.path.dirname(os.path.abspath(__file__))),
                 "env": dict(worker_env or {}), "force_cpu": bool(force_cpu),
                 "attempt": attempt, "checkpoint_dir": checkpoint_dir,
-                "checkpoint_freq": int(checkpoint_freq)}
+                "checkpoint_freq": int(checkpoint_freq),
+                "heartbeat_dir": hb_dir}
         spec_path = os.path.join(work, f"spec_{attempt}.json")
         with open(spec_path, "w") as f:
             json.dump(spec, f)
@@ -292,25 +331,56 @@ def _train_distributed_in(work, params, data, label, weight, group,
                 [sys.executable, script, spec_path, str(r)],
                 stdout=log_files[r], stderr=subprocess.STDOUT, text=True,
                 env=env) for r in range(num_machines)]
-            result = supervise(procs, log_paths, timeout,
-                               poll_interval=poll_interval)
+            result = supervise(
+                procs, log_paths, timeout, poll_interval=poll_interval,
+                heartbeats=[os.path.join(hb_dir, f"heartbeat-rank{r}")
+                            for r in range(num_machines)],
+                stall_timeout=stall_timeout,
+                stall_dir=str(params.get("metrics_dir") or "") or hb_dir)
         finally:
             for lf in log_files:
                 lf.close()
         if result.ok and os.path.exists(model_out):
             if attempt > 0:
                 log.info(f"Distributed training succeeded on retry "
-                         f"{attempt} (resumed from {checkpoint_dir})")
+                         f"{attempt} (resumed from {checkpoint_dir})"
+                         + (f" with degraded knobs {degraded_knobs}"
+                            if degraded_knobs else ""))
                 if evt is not None:
-                    evt.emit("cluster_retry_succeeded", attempt=attempt)
-            return Booster(model_file=model_out)
+                    evt.emit("cluster_retry_succeeded", attempt=attempt,
+                             degraded_knobs=degraded_knobs)
+            booster = Booster(model_file=model_out)
+            booster.degraded_knobs = list(degraded_knobs)
+            return booster
         last_failure = result.describe() if not result.ok else \
             "all workers exited 0 but no model file was written"
         if evt is not None:
             evt.emit("cluster_attempt_failed", attempt=attempt,
+                     classification=("hang" if result.hang else "crash"),
                      failure=last_failure.splitlines()[0]
                      if last_failure else "")
         if attempt < max_retries:
+            if result.hang and auto_degrade:
+                # graceful degradation (reliability/guard.py): the
+                # attempt HUNG, so the relaunch disables the next risky
+                # knob instead of replaying the same configuration into
+                # the same stall
+                effective = {k: getattr(Config(dict(worker_params)), k)
+                             for k in _LADDER_KNOBS}
+                knob = next_degradation(effective, degraded_knobs)
+                if knob is not None:
+                    worker_params[knob] = disabled_value(knob)
+                    degraded_knobs.append(knob)
+                    log.warning(
+                        f"auto_degrade: attempt {attempt} hung; "
+                        f"relaunching with {knob} disabled "
+                        f"(degraded so far: {degraded_knobs})")
+                    if evt is not None:
+                        evt.emit("degrade", knob=knob, attempt=attempt + 1,
+                                 active=list(degraded_knobs))
+                else:
+                    log.warning("auto_degrade: ladder exhausted; "
+                                "relaunching unchanged")
             delay = retry_backoff * (2 ** attempt)
             if evt is not None:
                 evt.emit("cluster_retry", next_attempt=attempt + 1,

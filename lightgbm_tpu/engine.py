@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -91,23 +92,39 @@ def train(params: Dict[str, Any], train_set: Dataset,
         checkpoint_freq = cfg.checkpoint_freq
     if resume is None:
         resume = cfg.resume
+    if metrics_dir is None:
+        metrics_dir = cfg.metrics_dir or None
+
+    # ---- degradation ladder (docs/Reliability.md) ----
+    # a previous attempt that HUNG left a stall-rank<r>.json in
+    # metrics_dir; with auto_degrade this restart consumes it, disables
+    # the next risky knob (donation -> compile cache -> async_host_io ->
+    # device_eval) and resumes from the checkpoint instead of re-hanging
+    degrade_info = {"applied": [], "new": [], "stall": None}
+    if cfg.auto_degrade:
+        from .observability import process_rank
+        from .reliability.guard import apply_auto_degrade
+        degrade_info = apply_auto_degrade(cfg, params, metrics_dir,
+                                          rank=process_rank())
     # async host services (docs/Performance.md): one bounded writer
     # thread drains event-log appends and checkpoint serialization so
     # the training loop never blocks on host I/O; `async_host_io=false`
     # restores synchronous writes (byte-identical output either way)
     writer = None
-    if cfg.async_host_io and (checkpoint_dir or metrics_dir
-                              or cfg.metrics_dir):
+    if cfg.async_host_io and (checkpoint_dir or metrics_dir):
         from .observability import AsyncWriter
         writer = AsyncWriter()
+    if writer is not None or metrics_dir:
+        # a supervisor SIGTERM must flush the queued events/checkpoints
+        # before the process dies — the log tail is the diagnosis
+        from .observability import install_sigterm_flush
+        install_sigterm_flush()
     ckpt_mgr = (CheckpointManager(checkpoint_dir,
                                   keep_last=cfg.checkpoint_keep,
                                   params=params, writer=writer)
                 if checkpoint_dir else None)
 
     # ---- observability setup (docs/Observability.md) ----
-    if metrics_dir is None:
-        metrics_dir = cfg.metrics_dir or None
     profile_dir = cfg.profile_dir or None
     event_logger = None
     timer_was_enabled = global_timer.enabled
@@ -122,6 +139,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         global_timer.enabled = True
         event_logger.emit("train_start", num_boost_round=num_boost_round,
                           params=cfg.changed_params())
+        if degrade_info["new"]:
+            # one `degrade` event per ladder step, right at the top of
+            # the restarted run's log
+            event_logger.emit("degrade", knobs=degrade_info["new"],
+                              active=degrade_info["applied"],
+                              stall_iteration=(degrade_info["stall"] or {})
+                              .get("last_iteration"))
     profiling = False
     if profile_dir:
         try:
@@ -193,10 +217,43 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 booster._gbdt.restore_train_state(resume_ckpt.load_state())
         return booster
 
+    # ---- stall watchdog (reliability/guard.py) ----
+    # active when there is somewhere for the diagnosis to land: the run's
+    # metrics_dir, or the directory the distributed supervisor provided
+    # (LGBM_TPU_STALL_DIR / the heartbeat file's directory)
+    run_guard = None
+    hb_path = os.environ.get("LGBM_TPU_HEARTBEAT_FILE") or None
+    guard_dir = (metrics_dir or os.environ.get("LGBM_TPU_STALL_DIR")
+                 or (os.path.dirname(hb_path) if hb_path else None))
+    if cfg.stall_floor_s > 0 and guard_dir:
+        from .observability import process_rank
+        from .reliability.guard import RunGuard
+        run_guard = RunGuard(
+            guard_dir, rank=process_rank(),
+            stall_floor_s=cfg.stall_floor_s,
+            stall_factor=cfg.stall_factor,
+            knobs={"tpu_donate_buffers": cfg.tpu_donate_buffers,
+                   "async_host_io": cfg.async_host_io,
+                   "compile_cache_dir": cfg.compile_cache_dir,
+                   "device_eval": cfg.device_eval,
+                   "sharded_wave": False,
+                   "auto_degrade": cfg.auto_degrade,
+                   "degraded_knobs": list(degrade_info["applied"])},
+            heartbeat_path=hb_path, writer=writer)
+        run_guard.start()
+
     rollbacks = 0
     try:
         while True:
             booster = _build_booster()
+            if run_guard is not None:
+                # the mesh (sharded wave) engages only once the booster
+                # exists — refresh the risky-knob fingerprint
+                gbdt = getattr(booster, "_gbdt", None)
+                run_guard.update_knobs(
+                    sharded_wave=bool(getattr(gbdt, "mesh", None)
+                                      is not None),
+                    growth_strategy=getattr(gbdt, "growth_strategy", None))
             callbacks = list(user_callbacks)
             if cfg.early_stopping_round > 0 and valid_sets:
                 callbacks.append(early_stopping(
@@ -262,6 +319,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     env.evaluation_result_list = evals
                     for cb in callbacks_after:
                         cb(env)
+                    if run_guard is not None:
+                        run_guard.tick(i + 1)
             except EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 for name, metric, value, _ in e.best_score:
@@ -309,6 +368,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 counters=global_registry.snapshot()["counters"])
         return booster
     finally:
+        if run_guard is not None:
+            run_guard.stop()
         global_timer.enabled = timer_was_enabled
         if profiling:
             try:

@@ -19,7 +19,7 @@ Knobs:
 from .compile_cache import configure_compile_cache
 from .events import (EventLogger, emit_event, get_event_logger,
                      set_event_logger)
-from .hostio import AsyncWriter
+from .hostio import AsyncWriter, flush_host_io, install_sigterm_flush
 from .registry import MetricsRegistry, global_registry, process_rank
 from .watchdog import (RecompileDetector, sample_device_memory,
                        update_memory_gauges)
@@ -27,6 +27,7 @@ from .watchdog import (RecompileDetector, sample_device_memory,
 __all__ = [
     "AsyncWriter", "configure_compile_cache",
     "EventLogger", "emit_event", "get_event_logger", "set_event_logger",
+    "flush_host_io", "install_sigterm_flush",
     "MetricsRegistry", "global_registry", "process_rank",
     "RecompileDetector", "sample_device_memory", "update_memory_gauges",
 ]

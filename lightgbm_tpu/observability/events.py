@@ -62,6 +62,9 @@ class EventLogger:
         self.path = os.path.join(self.dir, f"events-rank{self.rank}.jsonl")
         self.rotate_bytes = int(float(rotate_mb) * (1 << 20))
         self.writer = writer
+        # the stall watchdog embeds the run's last record in its
+        # diagnosis — the "how far did we get" marker r05 never had
+        self.last_record = None
         self._fh = open(self.path, "a")
 
     def _rotate(self) -> None:
@@ -78,6 +81,7 @@ class EventLogger:
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event, "ts": time.time(), "rank": self.rank}
         rec.update(fields)
+        self.last_record = rec
         line = json.dumps(rec, default=_json_default) + "\n"
         if self.writer is not None:
             self.writer.submit(self._append, line)
@@ -93,6 +97,16 @@ class EventLogger:
                 pass  # a failed rotation must never kill training
         self._fh.write(line)
         self._fh.flush()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Land every queued record on disk (bounded wait in async mode:
+        the SIGTERM handler calls this and must not wedge the exit)."""
+        try:
+            if self.writer is not None:
+                self.writer.flush(timeout=timeout)
+            self._fh.flush()
+        except (OSError, ValueError):
+            pass
 
     def close(self) -> None:
         try:

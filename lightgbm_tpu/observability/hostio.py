@@ -26,11 +26,19 @@ fallback) rather than being dropped.
 
 from __future__ import annotations
 
+import os
 import queue
+import signal
 import threading
+import time
+import weakref
 
 from ..utils import log
 from .registry import global_registry
+
+# every live AsyncWriter, so the SIGTERM flush handler can drain them
+# all without the engine threading handles into the signal layer
+_live_writers: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class AsyncWriter:
@@ -41,6 +49,7 @@ class AsyncWriter:
         self._thread = None
         self._lock = threading.Lock()
         self._closed = False
+        _live_writers.add(self)
 
     # ------------------------------------------------------------- worker
     def _ensure_thread(self) -> None:
@@ -79,10 +88,22 @@ class AsyncWriter:
         self._ensure_thread()
         self._q.put((fn, args, kwargs))
 
-    def flush(self) -> None:
-        """Block until every task submitted so far has executed."""
-        if self._thread is not None and self._thread.is_alive():
+    def flush(self, timeout: float = None) -> None:
+        """Block until every task submitted so far has executed.  With
+        `timeout` the wait is bounded (polling unfinished_tasks): the
+        stall watchdog and the SIGTERM handler flush through here and
+        must never wedge on a worker that is itself part of the hang."""
+        if self._thread is None or not self._thread.is_alive():
+            return
+        if timeout is None:
             self._q.join()
+            return
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._q.all_tasks_done:
+                if self._q.unfinished_tasks == 0:
+                    return
+            time.sleep(0.02)
 
     def close(self) -> None:
         """Flush, stop the worker, switch to inline fallback."""
@@ -98,3 +119,57 @@ class AsyncWriter:
     @property
     def pending(self) -> int:
         return self._q.qsize()
+
+
+# --------------------------------------------------------------------------
+# SIGTERM flush: a supervisor kill must never drop the final events that
+# would explain the failure
+# --------------------------------------------------------------------------
+
+_sigterm_installed = False
+
+
+def flush_host_io(timeout: float = 5.0) -> None:
+    """Bounded flush of every live AsyncWriter and the run's EventLogger
+    (in that order: the logger's queued appends drain through its
+    writer first, then its file handle is fsync'd to the OS)."""
+    for w in list(_live_writers):
+        try:
+            w.flush(timeout=timeout)
+        except Exception:  # noqa: BLE001 - flushing must never raise
+            pass
+    from .events import get_event_logger
+    lg = get_event_logger()
+    if lg is not None:
+        lg.flush(timeout=timeout)
+
+
+def install_sigterm_flush() -> bool:
+    """Install a SIGTERM handler that emits a final `sigterm` event,
+    drains the async host-I/O queue (bounded wait) and then re-raises
+    the default termination — so a worker killed by the supervisor dies
+    with a COMPLETE event log instead of losing the tail that would have
+    explained the failure.  Idempotent; returns False when it cannot be
+    installed (non-main thread, platforms without SIGTERM handling)."""
+    global _sigterm_installed
+    if _sigterm_installed:
+        return True
+
+    def _handler(signum, frame):
+        from .events import emit_event
+        try:
+            emit_event("sigterm", pid=os.getpid())
+        except Exception:  # noqa: BLE001
+            pass
+        flush_host_io(timeout=5.0)
+        # restore default disposition and re-deliver so the exit status
+        # is still "killed by SIGTERM" (143), which supervisors expect
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except (ValueError, OSError, AttributeError):
+        return False  # not the main thread / unsupported platform
+    _sigterm_installed = True
+    return True

@@ -11,6 +11,11 @@ layer and whole-file model writes (ref survey §1, src/network/):
 * fault injection — env-driven crash/NaN/write-failure hooks so the
   recovery paths above are testable without real hardware faults
   (`faults.py`, `LGBM_TPU_FAULT=worker_crash@3,...`).
+* stall watchdog + degradation ladder — `guard.py` turns live-but-hung
+  runs (the MULTICHIP_r05 shape: a rank wedged in a collective) into a
+  structured stall diagnosis and a distinct exit code, and with
+  `auto_degrade=true` relaunches from checkpoint with the next risky
+  knob disabled.
 """
 
 from __future__ import annotations
@@ -26,5 +31,9 @@ class NonFiniteError(LightGBMError):
 
 from . import faults  # noqa: E402
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: E402
+from .guard import (DEGRADE_LADDER, STALL_EXIT_CODE,  # noqa: E402
+                    RunGuard, classify_returncode)
 
-__all__ = ["Checkpoint", "CheckpointManager", "NonFiniteError", "faults"]
+__all__ = ["Checkpoint", "CheckpointManager", "NonFiniteError", "faults",
+           "RunGuard", "STALL_EXIT_CODE", "DEGRADE_LADDER",
+           "classify_returncode"]

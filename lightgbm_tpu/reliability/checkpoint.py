@@ -52,6 +52,10 @@ _HASH_EXCLUDE = frozenset((
     "metrics_dir", "metrics_rotate_mb", "profile_dir",
     "async_host_io", "compile_cache_dir", "device_eval",
     "device_predict", "device_predict_min_bucket",
+    # the degradation ladder (reliability/guard.py) flips these between
+    # attempts; all are model-neutral perf/telemetry knobs, and a
+    # degraded relaunch MUST still resume the interrupted checkpoint
+    "tpu_donate_buffers", "auto_degrade", "stall_floor_s", "stall_factor",
 ))
 
 

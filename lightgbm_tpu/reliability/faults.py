@@ -8,6 +8,15 @@ the fault).  Kinds:
 * `worker_crash@3`   — `os._exit(17)` at the start of boosting iteration 3
 * `nan_grad@5`       — poison the iteration-5 gradients with NaN
 * `ckpt_write_fail@2`— raise OSError from the iteration-2 checkpoint write
+* `hang@3`           — wedge forever at the start of iteration 3 (the
+  MULTICHIP_r05 shape: the process stays LIVE, so only the stall
+  watchdog / heartbeat staleness can catch it)
+* `slow_iter@4`      — sleep `LGBM_TPU_FAULT_SLOW_S` (default 2.0)
+  seconds inside iteration 4: slow, but NOT a stall — the watchdog's
+  rolling-median deadline must not trip on it
+* `collective_stall@2` — wedge forever immediately BEFORE the grow
+  program dispatch; rank-gated, it models one rank entering a
+  collective late so every peer blocks inside psum
 
 `LGBM_TPU_FAULT_RANK` (optional) restricts firing to one worker: it is
 compared against `LGBM_TPU_FAULT_SELF_RANK`, which the distributed worker
@@ -32,7 +41,8 @@ CRASH_EXIT_CODE = 17
 # parsed (kind, iteration, attempt) specs; None = env not parsed yet
 _specs: Optional[List[Tuple[str, int, int]]] = None
 
-_KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail")
+_KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail",
+          "hang", "slow_iter", "collective_stall")
 
 
 def _parse() -> List[Tuple[str, int, int]]:
@@ -119,6 +129,56 @@ def maybe_nan_grad(grad, hess, iteration: int):
                     f"iteration {iteration}")
         return grad * float("nan"), hess
     return grad, hess
+
+
+def _wedge(kind: str, iteration: int) -> None:
+    """Simulate a live-but-hung process: sleep forever in short slices
+    (so os._exit from the watchdog thread, SIGTERM/SIGKILL from the
+    supervisor, and SIGUSR1 stack dumps all still work)."""
+    sys.stderr.write(f"[LGBM_TPU_FAULT] injected {kind} at iteration "
+                     f"{iteration}: process stays alive but makes no "
+                     "progress\n")
+    sys.stderr.flush()
+    import time
+    while True:
+        time.sleep(1.0)
+
+
+def maybe_hang(iteration: int) -> None:
+    """hang / slow_iter hooks, at the start of a boosting iteration."""
+    if _should_fire("hang", iteration):
+        _record_injection("hang", iteration)
+        _wedge("hang", iteration)
+    if _should_fire("slow_iter", iteration):
+        _record_injection("slow_iter", iteration)
+        import time
+        dur = float(os.environ.get("LGBM_TPU_FAULT_SLOW_S", "2.0"))
+        log.warning(f"[LGBM_TPU_FAULT] injecting slow_iter at iteration "
+                    f"{iteration}: sleeping {dur:.1f}s")
+        time.sleep(dur)
+
+
+def maybe_collective_stall(iteration: int) -> None:
+    """collective_stall hook, immediately before the grow-program
+    dispatch: with rank gating, the other ranks enter the histogram
+    psum and block on this one."""
+    if _should_fire("collective_stall", iteration):
+        _record_injection("collective_stall", iteration)
+        _wedge("collective_stall", iteration)
+
+
+def register_stack_dump_signal() -> bool:
+    """Register faulthandler on SIGUSR1 so an operator (or the
+    supervisor) can get an all-thread stack dump from a LIVE worker
+    without killing it: `kill -USR1 <pid>`.  Returns False where
+    unsupported (non-main thread, platforms without SIGUSR1)."""
+    try:
+        import faulthandler
+        import signal
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=True)
+        return True
+    except (AttributeError, ImportError, ValueError, RuntimeError):
+        return False
 
 
 def maybe_ckpt_write_fail(iteration: int) -> None:

@@ -6,7 +6,23 @@ a peer dies, so the SPAWNING process must watch the children: poll every
 worker, and on the first non-zero exit kill the rest of the cluster
 immediately instead of letting the survivors stall to the global
 timeout (ISSUE: a rank dead at t=0 previously blocked every other rank
-for the full 900 s deadline)."""
+for the full 900 s deadline).
+
+Dead PIDs are the easy half.  The MULTICHIP_r05 failure mode is a rank
+that stays LIVE while wedged inside a collective — no exit code ever
+arrives.  Two complementary detectors close that hole (ISSUE 7):
+
+* each worker's `RunGuard` (reliability/guard.py) ticks a per-rank
+  heartbeat FILE once per boosting iteration; the supervisor polls the
+  files' mtimes and, when every process is still alive but a heartbeat
+  has gone stale past `stall_timeout`, kills the cluster and classifies
+  the stale rank as HUNG — surfacing its `stall-rank<r>.json` tail (the
+  guard usually wrote one just before, or will not get the chance —
+  either way the mtime is the ground truth);
+* a worker whose own watchdog fired exits with `STALL_EXIT_CODE`, which
+  `classify_returncode` maps to "hang" rather than "crash", so the retry
+  layer can choose the degradation ladder instead of a plain relaunch.
+"""
 
 from __future__ import annotations
 
@@ -15,12 +31,16 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from .guard import classify_returncode, stall_file_path
+
 
 @dataclass
 class WorkerFailure:
     rank: int
-    returncode: Optional[int]  # None = killed after timeout
+    returncode: Optional[int]  # None = killed after timeout/stall
     log_tail: str
+    kind: str = "crash"  # "crash" | "hang" | "timeout"
+    stall_tail: str = ""  # tail of stall-rank<r>.json when one exists
 
 
 @dataclass
@@ -29,6 +49,13 @@ class SuperviseResult:
     timed_out: bool
     failures: List[WorkerFailure] = field(default_factory=list)
 
+    @property
+    def hang(self) -> bool:
+        """True when the attempt died of a stall (live-but-hung rank or
+        a worker's own watchdog), not a crash — the degradation ladder
+        only makes sense for hangs."""
+        return any(f.kind == "hang" for f in self.failures)
+
     def describe(self) -> str:
         if self.ok:
             return "all workers exited 0"
@@ -36,10 +63,16 @@ class SuperviseResult:
         if self.timed_out:
             parts.append("cluster hit the launch deadline")
         for f in self.failures:
-            rc = "killed (timeout)" if f.returncode is None \
-                else f"exit code {f.returncode}"
+            if f.returncode is None:
+                rc = ("killed (heartbeat stale: live-but-hung)"
+                      if f.kind == "hang" else "killed (timeout)")
+            else:
+                rc = f"exit code {f.returncode} ({f.kind})"
             parts.append(f"rank {f.rank} failed ({rc}); log tail:\n"
                          f"{f.log_tail or '(empty log)'}")
+            if f.stall_tail:
+                parts.append(f"rank {f.rank} stall diagnosis "
+                             f"(stall-rank{f.rank}.json):\n{f.stall_tail}")
         return "\n".join(parts)
 
 
@@ -56,16 +89,56 @@ def tail_file(path: str, max_bytes: int = 4096) -> str:
         return "(log unavailable)"
 
 
+def _stall_tail(stall_dir: Optional[str], rank: int) -> str:
+    """Tail of rank's stall diagnosis, '' when none was written."""
+    if not stall_dir:
+        return ""
+    path = stall_file_path(stall_dir, rank)
+    if not os.path.exists(path):
+        return ""
+    return tail_file(path, max_bytes=2048)
+
+
+def _stale_ranks(heartbeats: Optional[List[str]], stall_timeout: float,
+                 started: float, pending) -> List[int]:
+    """Ranks whose heartbeat file has not been touched for
+    `stall_timeout` seconds.  A missing file counts from launch time:
+    a worker that never completed one iteration is exactly the
+    wedged-in-first-collective shape."""
+    if not heartbeats or stall_timeout <= 0:
+        return []
+    now = time.time()
+    stale = []
+    for r in sorted(pending):
+        try:
+            age = now - os.path.getmtime(heartbeats[r])
+        except OSError:
+            age = now - started
+        if age >= stall_timeout:
+            stale.append(r)
+    return stale
+
+
 def supervise(procs, log_paths: List[str], timeout: float,
-              poll_interval: float = 0.25) -> SuperviseResult:
-    """Watch `procs` until they all exit, one fails, or `timeout` passes.
+              poll_interval: float = 0.25,
+              heartbeats: Optional[List[str]] = None,
+              stall_timeout: float = 0.0,
+              stall_dir: Optional[str] = None) -> SuperviseResult:
+    """Watch `procs` until they all exit, one fails, a heartbeat goes
+    stale, or `timeout` passes.
 
     On the first non-zero exit the remaining workers are killed at once
-    (they are wedged in collectives waiting for the dead rank).  Always
+    (they are wedged in collectives waiting for the dead rank).  With
+    `heartbeats` (one path per rank) and `stall_timeout > 0`, a rank
+    that is ALIVE but has not ticked for `stall_timeout` seconds is
+    classified as hung and the cluster is killed the same way — the old
+    behavior was to wait out the full `timeout` on such ranks.  Always
     reaps every process before returning."""
+    started = time.time()
     deadline = time.monotonic() + timeout
     pending = set(range(len(procs)))
     failed: List[int] = []
+    stalled: List[int] = []
     timed_out = False
     while pending:
         for r in sorted(pending):
@@ -77,12 +150,15 @@ def supervise(procs, log_paths: List[str], timeout: float,
                 failed.append(r)
         if failed or not pending:
             break
+        stalled = _stale_ranks(heartbeats, stall_timeout, started, pending)
+        if stalled:
+            break
         if time.monotonic() >= deadline:
             timed_out = True
             break
         time.sleep(poll_interval)
 
-    for r in pending:  # kill survivors: wedged (peer died) or overdue
+    for r in pending:  # kill survivors: wedged (peer died/hung) or overdue
         procs[r].kill()
     for p in procs:
         try:
@@ -91,11 +167,21 @@ def supervise(procs, log_paths: List[str], timeout: float,
             p.kill()
             p.wait()
 
-    failures = [WorkerFailure(r, procs[r].returncode, tail_file(log_paths[r]))
-                for r in failed]
+    failures = [
+        WorkerFailure(r, procs[r].returncode, tail_file(log_paths[r]),
+                      kind=classify_returncode(procs[r].returncode),
+                      stall_tail=_stall_tail(stall_dir, r))
+        for r in failed]
+    for r in stalled:
+        # killed by US for heartbeat staleness: the returncode is the
+        # kill signal, which classify_returncode would miscall "crash"
+        failures.append(WorkerFailure(
+            r, None, tail_file(log_paths[r]), kind="hang",
+            stall_tail=_stall_tail(stall_dir, r)))
     if timed_out:
         failures.extend(
-            WorkerFailure(r, None, tail_file(log_paths[r]))
+            WorkerFailure(r, None, tail_file(log_paths[r]), kind="timeout",
+                          stall_tail=_stall_tail(stall_dir, r))
             for r in sorted(pending))
     ok = not failures and not timed_out
     return SuperviseResult(ok=ok, timed_out=timed_out, failures=failures)

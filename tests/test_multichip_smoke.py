@@ -137,6 +137,81 @@ def test_donation_gated_off_under_mesh():
     assert booster.current_iteration() == 2
 
 
+_STALL_CHILD = r"""
+import os, sys
+sys.path.insert(0, os.environ["STALL_REPO"])
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import lightgbm_tpu as lgb
+from tests.test_multichip_smoke import _problem, _params
+d = os.environ["STALL_DIR"]
+X, y = _problem()
+p = _params(True, True)
+p.update({"metrics_dir": os.path.join(d, "metrics"),
+          "checkpoint_dir": os.path.join(d, "ckpt"), "checkpoint_freq": 1,
+          "auto_degrade": True, "stall_floor_s": 2.0, "stall_factor": 3.0})
+b = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+assert np.isfinite(b.predict(X[:64])).all()
+print("STALL_SMOKE_OK", b.current_iteration(), flush=True)
+"""
+
+
+def test_stall_injection_diagnosed_and_degraded_under_mesh(tmp_path):
+    """ISSUE 7 acceptance on the 8-device mesh: an injected hang during
+    sharded-wave training produces a stall-rank0.json (stack + knob
+    fingerprint with the mesh engaged), the exit is classified as a
+    HANG (not a crash) by the supervisor, and the auto_degrade relaunch
+    completes from checkpoint with exactly one ladder knob disabled."""
+    import json
+
+    from lightgbm_tpu.reliability.guard import STALL_EXIT_CODE
+    from lightgbm_tpu.reliability.supervisor import classify_returncode
+
+    script = tmp_path / "stall_child.py"
+    script.write_text(_STALL_CHILD)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    env.update({"STALL_DIR": str(tmp_path), "STALL_REPO": repo,
+                "LGBM_TPU_FAULT": "hang@2@0",
+                "LGBM_TPU_FAULT_ATTEMPT": "0"})
+
+    # attempt 0: wedges at iteration 2 mid-mesh-training
+    r0 = subprocess.run([sys.executable, str(script)], cwd=repo, env=env,
+                        capture_output=True, text=True,
+                        timeout=RUN_BUDGET_S)
+    assert r0.returncode == STALL_EXIT_CODE, (
+        f"expected the stall exit code, got rc={r0.returncode}\n"
+        f"stdout: {r0.stdout[-2000:]}\nstderr: {r0.stderr[-2000:]}")
+    assert classify_returncode(r0.returncode) == "hang"
+    diag = json.load(open(tmp_path / "metrics" / "stall-rank0.json"))
+    assert diag["last_iteration"] == 2
+    assert diag["knobs"]["sharded_wave"] is True
+    assert any("File" in line for line in diag["stacks"])
+
+    # attempt 1: the engine consumes the diagnosis, disables the first
+    # ladder knob and resumes from the iteration-2 checkpoint
+    env["LGBM_TPU_FAULT_ATTEMPT"] = "1"
+    r1 = subprocess.run([sys.executable, str(script)], cwd=repo, env=env,
+                        capture_output=True, text=True,
+                        timeout=RUN_BUDGET_S)
+    assert r1.returncode == 0, (
+        f"degraded relaunch failed rc={r1.returncode}\n"
+        f"stdout: {r1.stdout[-2000:]}\nstderr: {r1.stderr[-2000:]}")
+    assert "STALL_SMOKE_OK 5" in r1.stdout
+    state = json.load(open(tmp_path / "metrics" / "degrade-state.json"))
+    assert state["degraded_knobs"] == ["tpu_donate_buffers"]
+    events = [json.loads(ln) for ln in
+              (tmp_path / "metrics" / "events-rank0.jsonl")
+              .read_text().splitlines()]
+    assert any(e["event"] == "degrade"
+               and e["knobs"] == ["tpu_donate_buffers"] for e in events)
+
+
 def test_compile_cache_under_mesh_subprocess(tmp_path):
     """compile_cache_dir x 8-device mesh in a FRESH process (the r05 dry
     run is also a fresh process): must train and exit 0 inside the
