@@ -15,6 +15,7 @@ from typing import List
 
 import numpy as np
 
+from ..utils import log
 from .gbdt import GBDT
 
 
@@ -36,6 +37,73 @@ class DART(GBDT):
             self._sync_model()  # dropping reads host trees
             self._dropping_trees()
             self._dropped_cur_iter = True
+
+    # ------------------------------------------------- checkpoint state
+    def capture_train_state(self, async_copy: bool = False):
+        """DART drop-state rides the checkpoint (byte-exact resume,
+        docs/Reliability.md): the dropped-tree selection RNG stream, the
+        normalization counters (per-tree weights and their sum), and the
+        full-precision per-tree shrinkage/internal_value — the model
+        text prints those two at reference-compatible %g precision, and
+        dropping keeps MULTIPLYING them, so a resume seeded from text
+        alone drifts from the uninterrupted run at the first re-drop of
+        an adopted tree."""
+        state = super().capture_train_state(async_copy)
+        if state is None:
+            return None
+        state["dart_rng_drop"] = np.array(
+            self._rng_drop.get_state(legacy=False), dtype=object)
+        state["dart_tree_weight"] = np.asarray(self.tree_weight_, np.float64)
+        state["dart_sum_weight"] = np.float64(self.sum_weight_)
+        trees = self.models_
+        state["dart_shrinkage"] = np.asarray(
+            [t.shrinkage for t in trees], np.float64)
+        sizes = [max(t.num_leaves - 1, 0) for t in trees]
+        state["dart_internal_sizes"] = np.asarray(sizes, np.int64)
+        state["dart_internal_value"] = (
+            np.concatenate([np.asarray(t.internal_value[:n], np.float64)
+                            for t, n in zip(trees, sizes)])
+            if trees else np.zeros(0, np.float64))
+        return state
+
+    def restore_train_state(self, state) -> bool:
+        ok = super().restore_train_state(state)
+        if state is None or "dart_rng_drop" not in state:
+            # plain init_model continuation: reference semantics (the
+            # adopted trees are never dropped, fresh drop RNG)
+            return ok
+        st = state["dart_rng_drop"]
+        try:
+            self._rng_drop.set_state(st.item() if hasattr(st, "item")
+                                     else st)
+        except (ValueError, TypeError) as e:
+            log.warning(f"Could not restore DART drop RNG state: {e}")
+        tw = state.get("dart_tree_weight")
+        if tw is not None:
+            self.tree_weight_ = [float(x) for x in np.asarray(tw)]
+        self.sum_weight_ = float(state.get("dart_sum_weight",
+                                           sum(self.tree_weight_)))
+        sh = state.get("dart_shrinkage")
+        if sh is not None and len(sh) == len(self.models_):
+            for t, s in zip(self.models_, np.asarray(sh, np.float64)):
+                t.shrinkage = float(s)
+        sizes = state.get("dart_internal_sizes")
+        ivals = state.get("dart_internal_value")
+        if sizes is not None and ivals is not None \
+                and len(sizes) == len(self.models_):
+            off = 0
+            for t, n in zip(self.models_, np.asarray(sizes, np.int64)):
+                t.internal_value[:n] = np.asarray(ivals[off:off + n],
+                                                  np.float64)
+                off += int(n)
+        # a checkpoint resume CONTINUES the same DART run: the adopted
+        # trees must stay droppable, so fold them back into `iter_`
+        # (continue_from counted them as frozen init trees).  Every
+        # absolute-iteration consumer reads the sum num_init_iteration_
+        # + iter_, which is unchanged.
+        self.iter_ = self.num_init_iteration_
+        self.num_init_iteration_ = 0
+        return ok
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         cfg = self.config

@@ -939,6 +939,8 @@ class GBDT:
                       "(rf vs gbdt/dart): tree outputs would be combined "
                       "with the wrong weights")
         self.models_ = [_copy.deepcopy(t) for t in prev.models_]
+        for t in self.models_:
+            self._reconstruct_bin_space(t)
         self.num_init_iteration_ = len(self.models_) // max(K, 1)
         self.iter_ = 0
         X = (train_raw if train_raw is not None
@@ -1173,6 +1175,7 @@ class GBDT:
         K = self.num_tree_per_iteration
         if faults.active():
             faults.maybe_crash(self.num_init_iteration_ + self.iter_)
+            faults.maybe_worker_lost(self.num_init_iteration_ + self.iter_)
             faults.maybe_hang(self.num_init_iteration_ + self.iter_)
         # sentinel flags fetched for the previous iteration are stale now
         self._finite_cache = None
@@ -1577,6 +1580,61 @@ class GBDT:
             self._stop_training(stop_iter)
 
     # -------------------------------------------------------- score plumbing
+    def _reconstruct_bin_space(self, tree: Tree) -> None:
+        """Rebuild a text-adopted tree's BIN-space routing fields against
+        this run's bin mappers (threshold_in_bin, split_feature_inner,
+        inner categorical bitsets).  Model text stores real-valued
+        thresholds only; training-time score adds (_add_tree_score —
+        DART drops/normalize, RF averaging) route rows in bin space, so
+        without this a resumed DART run subtracts GARBAGE contributions
+        for every adopted tree it drops.  Exact inverse of
+        _arrays_to_tree's bin->value mapping: the real threshold IS
+        bin_upper_bound[bin], so searchsorted recovers the bin."""
+        ni = tree.num_leaves - 1
+        if getattr(tree, "_bin_space_valid", True):
+            return
+        tree._bin_space_valid = True
+        if ni <= 0:
+            return
+        ds = self.train_data
+        if ds is None or not getattr(ds, "bin_mappers", None):
+            return
+        from ..models.tree import K_CATEGORICAL_MASK, _to_bitset
+        inner_of = {f: i for i, f in enumerate(ds.used_features)}
+        cat_mask = (tree.decision_type[:ni] & K_CATEGORICAL_MASK) > 0
+        per_ci_bins: Dict[int, List[int]] = {}
+        for nd in range(ni):
+            f = int(tree.split_feature[nd])
+            if f in inner_of:
+                tree.split_feature_inner[nd] = inner_of[f]
+            mapper = ds.bin_mappers[f]
+            if cat_mask[nd]:
+                # outer bitset holds category VALUES; the inner one
+                # holds this dataset's bin indices for those values
+                ci = int(tree.threshold[nd])
+                tree.threshold_in_bin[nd] = ci
+                lo = tree.cat_boundaries[ci]
+                hi = tree.cat_boundaries[ci + 1]
+                cats = [32 * w + j
+                        for w, word in enumerate(tree.cat_threshold[lo:hi])
+                        for j in range(32) if (word >> j) & 1]
+                c2b = getattr(mapper, "categorical_2_bin", {})
+                per_ci_bins[ci] = _to_bitset(
+                    [c2b[c] for c in cats if c in c2b])
+                continue
+            ub = np.asarray(mapper.bin_upper_bound, np.float64)
+            b = int(np.searchsorted(ub, float(tree.threshold[nd]),
+                                    side="left"))
+            tree.threshold_in_bin[nd] = min(b, max(mapper.num_bin - 1, 0))
+        if tree.num_cat > 0:
+            ct_inner: List[int] = []
+            cb_inner = [0]
+            for ci in range(tree.num_cat):
+                ct_inner.extend(per_ci_bins.get(ci, []))
+                cb_inner.append(len(ct_inner))
+            tree.cat_threshold_inner = ct_inner
+            tree.cat_boundaries_inner = cb_inner
+
     def _tree_leaf_ids(self, tree: Tree, ds) -> np.ndarray:
         """Bin-space leaf index of every row for a tree trained on this
         dataset's bin mappers.  `ds` may store per-feature bins or (for

@@ -201,6 +201,17 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # from the last checkpoint with the next risky knob disabled
     # (donation -> compile cache -> async_host_io -> device_eval)
     ("auto_degrade", "bool", False, ("auto_degradation",)),
+    # preemption notice (SIGTERM) handling: grace budget for the
+    # on-demand checkpoint captured before the signal is re-delivered;
+    # 0 disables the checkpoint-on-demand (the handler only flushes)
+    ("preempt_ckpt_grace_s", "float", 10.0, ("preemption_grace_s",)),
+    # elastic recovery (distributed supervisor): a rank whose failures
+    # persist across this many seconds of consecutive relaunch attempts
+    # is classified permanently lost and the cluster shrinks around it
+    ("elastic_rank_grace_s", "float", 60.0, ("rank_loss_grace_s",)),
+    # smallest world size the elastic supervisor may shrink to; set it
+    # to num_machines to disable shrink-to-fit entirely
+    ("elastic_min_machines", "int", 1, ("min_machines",)),
     # --- observability (docs/Observability.md) ---
     # structured JSONL event log: one rank-tagged event per iteration
     ("metrics_dir", "str", "", ("telemetry_dir", "events_dir")),

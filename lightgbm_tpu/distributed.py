@@ -140,6 +140,16 @@ for k, v in spec.get("env", {}).items():
 # (retried clusters bump the attempt so one-shot faults don't re-fire)
 os.environ["LGBM_TPU_FAULT_SELF_RANK"] = str(rank)
 os.environ["LGBM_TPU_FAULT_ATTEMPT"] = str(spec.get("attempt", 0))
+os.environ["LGBM_TPU_WORLD_SIZE"] = str(spec["num_machines"])
+# permanent-loss model (reliability/faults.py): a tombstoned (rank,
+# world) refuses every same-world relaunch BEFORE joining the cluster,
+# so the refusal is a fast clean exit the supervisor sees immediately —
+# only an elastic shrink (different world size) gets past it
+if spec.get("tombstone_dir"):
+    os.environ["LGBM_TPU_TOMBSTONE_DIR"] = spec["tombstone_dir"]
+    sys.path.insert(0, spec["repo"])
+    from lightgbm_tpu.reliability import faults as _faults
+    _faults.check_tombstone()
 # stall detection (reliability/guard.py): the engine's RunGuard touches
 # this file once per boosting iteration; the supervising parent polls
 # its mtime to catch live-but-hung ranks, and the guard's stall
@@ -169,6 +179,18 @@ with open(spec["data"], "rb") as f:
     payload = pickle.load(f)
 params = dict(spec["params"])
 params.setdefault("tree_learner", "data")
+if spec.get("reshard"):
+    # elastic relaunch: every rank derives the identical deterministic
+    # row plan from the same three integers (parallel/elastic.py) — no
+    # coordination, no rank-0 broadcast; printed so the worker log
+    # records which rows this shard now owns
+    from lightgbm_tpu.parallel import reshard_plan, rows_of
+    rs = spec["reshard"]
+    if rs.get("num_rows"):
+        plan = reshard_plan(rs["old_n"], rs["new_n"], rs["num_rows"])
+        assert plan.new_n == spec["num_machines"]
+        print(f"worker {rank} reshard {plan.summary()} rows="
+              f"{rows_of(rs['num_rows'], rs['new_n'], rank)}", flush=True)
 if isinstance(payload, str):
     ds = lgb.Dataset(payload, params=params)
 else:
@@ -191,6 +213,21 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+def _ckpt_num_rows(checkpoint_dir: Optional[str]) -> Optional[int]:
+    """Training-row count recorded in the checkpoint manifest — the one
+    number the elastic reshard plan derives from, so the parent and
+    every relaunched rank agree on it without communicating."""
+    if not checkpoint_dir:
+        return None
+    try:
+        from .reliability.checkpoint import MANIFEST
+        with open(os.path.join(checkpoint_dir, MANIFEST)) as f:
+            n = json.load(f).get("num_rows")
+        return int(n) if n else None
+    except (OSError, ValueError, TypeError):
+        return None
 
 
 def train_distributed(params: Dict[str, Any], data, label=None, *,
@@ -243,6 +280,7 @@ def _train_distributed_in(work, params, data, label, weight, group,
                           retry_backoff=1.0, poll_interval=0.25,
                           stall_timeout=None):
     from .config import Config
+    from .reliability.elastic import GIVE_UP, SHRINK, ElasticPolicy
     from .reliability.guard import (disabled_value, next_degradation,
                                     _LADDER_KNOBS)
     from .reliability.supervisor import supervise
@@ -298,7 +336,15 @@ def _train_distributed_in(work, params, data, label, weight, group,
     # workers must not ALSO consume stall files and double-degrade
     worker_params = dict(params)
     worker_params["auto_degrade"] = False
+    # elastic shrink-to-fit (docs/Reliability.md §Elastic recovery): a
+    # permanently lost rank shrinks the next attempt's world size
+    # instead of relaunching into the same dead host forever
+    policy = ElasticPolicy(num_machines,
+                           min_machines=run_cfg.elastic_min_machines,
+                           rank_grace_s=run_cfg.elastic_rank_grace_s)
+    reshard: Optional[Dict[str, Any]] = None
     for attempt in range(max_retries + 1):
+        num_machines = policy.num_machines
         # fresh coordinator port per attempt: the previous coordinator
         # process is gone and its port may linger in TIME_WAIT
         port = _free_port()
@@ -316,7 +362,11 @@ def _train_distributed_in(work, params, data, label, weight, group,
                 "env": dict(worker_env or {}), "force_cpu": bool(force_cpu),
                 "attempt": attempt, "checkpoint_dir": checkpoint_dir,
                 "checkpoint_freq": int(checkpoint_freq),
-                "heartbeat_dir": hb_dir}
+                "heartbeat_dir": hb_dir,
+                # tombstones OUTLIVE attempts (unlike heartbeats): a
+                # permanently lost rank must refuse every same-world
+                # relaunch, so they key on the stable work dir
+                "tombstone_dir": work, "reshard": reshard}
         spec_path = os.path.join(work, f"spec_{attempt}.json")
         with open(spec_path, "w") as f:
             json.dump(spec, f)
@@ -345,22 +395,59 @@ def _train_distributed_in(work, params, data, label, weight, group,
                 log.info(f"Distributed training succeeded on retry "
                          f"{attempt} (resumed from {checkpoint_dir})"
                          + (f" with degraded knobs {degraded_knobs}"
-                            if degraded_knobs else ""))
+                            if degraded_knobs else "")
+                         + (f" on a shrunken {num_machines}-rank cluster"
+                            if policy.shrinks else ""))
                 if evt is not None:
                     evt.emit("cluster_retry_succeeded", attempt=attempt,
-                             degraded_knobs=degraded_knobs)
+                             degraded_knobs=degraded_knobs,
+                             num_machines=num_machines,
+                             elastic_shrinks=policy.shrinks)
             booster = Booster(model_file=model_out)
             booster.degraded_knobs = list(degraded_knobs)
+            booster.elastic_shrinks = policy.shrinks
+            booster.final_num_machines = num_machines
             return booster
         last_failure = result.describe() if not result.ok else \
             "all workers exited 0 but no model file was written"
+        genuine = bool(result.failures) or result.timed_out
+        classification = result.classification if genuine else "crash"
         if evt is not None:
             evt.emit("cluster_attempt_failed", attempt=attempt,
-                     classification=("hang" if result.hang else "crash"),
+                     classification=classification,
                      failure=last_failure.splitlines()[0]
                      if last_failure else "")
         if attempt < max_retries:
-            if result.hang and auto_degrade:
+            decision = policy.observe(result) if genuine else None
+            if decision is not None and decision.action == GIVE_UP:
+                log.fatal(
+                    f"distributed training cannot continue: "
+                    f"{decision.reason}\n{last_failure}")
+            if decision is not None and decision.action == SHRINK:
+                # shrink FIRST, then walk knobs (the ladder's hang
+                # evidence was gathered on a topology that no longer
+                # exists); the relaunch resumes from the checkpoint on
+                # the surviving world size with a deterministic row plan
+                # every rank recomputes identically
+                from .reliability.elastic import plan_for_shrink
+                old_n, new_n = num_machines, decision.num_machines
+                plan = plan_for_shrink(old_n, new_n,
+                                       _ckpt_num_rows(checkpoint_dir))
+                reshard = {"old_n": old_n, "new_n": new_n,
+                           "num_rows": plan.num_rows if plan else None}
+                log.warning(
+                    f"elastic_shrink: {decision.reason}; relaunching on "
+                    f"{new_n} rank(s)"
+                    + (f", reshard {plan.summary()}" if plan else "")
+                    + (f", resuming from {checkpoint_dir}"
+                       if checkpoint_dir else ""))
+                if evt is not None:
+                    evt.emit("elastic_shrink", old_num_machines=old_n,
+                             new_num_machines=new_n,
+                             lost_ranks=decision.lost_ranks,
+                             attempt=attempt + 1,
+                             reshard=plan.summary() if plan else None)
+            elif result.hang and auto_degrade:
                 # graceful degradation (reliability/guard.py): the
                 # attempt HUNG, so the relaunch disables the next risky
                 # knob instead of replaying the same configuration into
@@ -381,6 +468,13 @@ def _train_distributed_in(work, params, data, label, weight, group,
                 else:
                     log.warning("auto_degrade: ladder exhausted; "
                                 "relaunching unchanged")
+            elif classification == "preempt":
+                log.warning(
+                    f"attempt {attempt} was preempted (SIGTERM); the "
+                    "workers saved on-demand checkpoints inside the grace "
+                    "window — relaunching at the same world size"
+                    + (f", resuming from {checkpoint_dir}"
+                       if checkpoint_dir else ""))
             delay = retry_backoff * (2 ** attempt)
             if evt is not None:
                 evt.emit("cluster_retry", next_attempt=attempt + 1,

@@ -114,15 +114,58 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if cfg.async_host_io and (checkpoint_dir or metrics_dir):
         from .observability import AsyncWriter
         writer = AsyncWriter()
-    if writer is not None or metrics_dir:
-        # a supervisor SIGTERM must flush the queued events/checkpoints
-        # before the process dies — the log tail is the diagnosis
-        from .observability import install_sigterm_flush
-        install_sigterm_flush()
     ckpt_mgr = (CheckpointManager(checkpoint_dir,
                                   keep_last=cfg.checkpoint_keep,
                                   params=params, writer=writer)
                 if checkpoint_dir else None)
+    if writer is not None or metrics_dir or ckpt_mgr is not None:
+        # a supervisor SIGTERM must flush the queued events/checkpoints
+        # before the process dies — the log tail is the diagnosis; with
+        # a checkpoint dir the handler additionally saves an on-demand
+        # checkpoint (preemption notice, docs/Reliability.md)
+        from .observability import install_sigterm_flush
+        install_sigterm_flush()
+    # ---- preemption checkpoint-on-demand (docs/Reliability.md) ----
+    # `_progress` is the handler's view of the run: the live booster,
+    # the last COMPLETED iteration, and whether the main thread is
+    # inside booster.update() right now — mid-update, model text /
+    # scores / iteration are not a consistent triple, so the save is
+    # deferred to the iteration boundary (`preempt_pending`)
+    _progress: Dict[str, Any] = {"booster": None, "iteration": 0,
+                                 "in_update": False,
+                                 "preempt_pending": False}
+    if ckpt_mgr is not None and cfg.preempt_ckpt_grace_s > 0:
+        import time as _time
+
+        from .observability import set_preemption_hook
+
+        def _preempt_save():
+            if _progress["in_update"]:
+                # signal landed mid-update: queue it; the loop saves at
+                # the iteration boundary and finishes the termination
+                _progress["preempt_pending"] = True
+                return False
+            booster = _progress["booster"]
+            it = int(_progress["iteration"])
+            if booster is None or it <= 0:
+                return True
+            from .observability import emit_event, global_registry
+            t0 = _time.monotonic()
+            saved = False
+            try:
+                saved = ckpt_mgr.save_now(
+                    booster, it, grace_s=cfg.preempt_ckpt_grace_s) is not None
+            except OSError as e:
+                log.warning(f"Preemption checkpoint at iteration {it} "
+                            f"failed: {e}")
+            if saved:
+                global_registry.inc("preempt_ckpt_saved")
+            emit_event("preempt", iteration=it, saved=saved,
+                       elapsed_s=round(_time.monotonic() - t0, 3),
+                       grace_s=cfg.preempt_ckpt_grace_s)
+            return True
+
+        set_preemption_hook(_preempt_save)
 
     # ---- observability setup (docs/Observability.md) ----
     profile_dir = cfg.profile_dir or None
@@ -246,6 +289,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     try:
         while True:
             booster = _build_booster()
+            _progress["booster"] = booster
+            _progress["iteration"] = start_iteration
             if run_guard is not None:
                 # the mesh (sharded wave) engages only once the booster
                 # exists — refresh the risky-knob fingerprint
@@ -289,7 +334,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                       evaluation_result_list=[])
                     for cb in callbacks_before:
                         cb(env)
+                    _progress["in_update"] = True
                     stopped = booster.update(fobj=fobj)
+                    # the model/scores now describe iteration i+1 —
+                    # publish that BEFORE clearing in_update so a
+                    # preemption landing here saves a consistent triple
+                    _progress["iteration"] = i + 1
+                    _progress["in_update"] = False
+                    if _progress["preempt_pending"]:
+                        # a SIGTERM arrived mid-update; save at this
+                        # boundary, then finish the termination the
+                        # handler suppressed
+                        _progress["preempt_pending"] = False
+                        _preempt_save()
+                        from .observability.hostio import finish_preemption
+                        finish_preemption()
                     if stopped:
                         break
                     evals = []
@@ -368,6 +427,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 counters=global_registry.snapshot()["counters"])
         return booster
     finally:
+        if ckpt_mgr is not None and cfg.preempt_ckpt_grace_s > 0:
+            from .observability import clear_preemption_hook
+            clear_preemption_hook()
         if run_guard is not None:
             run_guard.stop()
         global_timer.enabled = timer_was_enabled

@@ -69,6 +69,13 @@ class Tree:
         self.cat_boundaries_inner: List[int] = [0]
         self.cat_threshold_inner: List[int] = []    # uint32 bitset words (bins)
         self.shrinkage = 1.0
+        # False only for trees parsed from model TEXT, whose bin-space
+        # routing fields (threshold_in_bin, split_feature_inner, inner
+        # cat bitsets) are unset — the text stores real-valued thresholds
+        # only.  GBDT.continue_from reconstructs them against the
+        # training dataset's bin mappers before any bin-space use
+        # (_add_tree_score: DART drops, RF averaging).
+        self._bin_space_valid = True
         self.is_linear = is_linear
         # linear-tree leaf models (ref: tree.h leaf_const_/leaf_coeff_/
         # leaf_features_; Shi et al. 1802.05640)
@@ -346,6 +353,11 @@ class Tree:
             return vals.astype(dtype)
 
         if ni > 0:
+            # bin-space routing cannot be recovered from text alone:
+            # flag it so continue_from reconstructs against the training
+            # dataset's bin mappers (real-threshold prediction is exact
+            # without it; only training-time score adds need bins)
+            t._bin_space_valid = False
             t.split_feature[:ni] = read_arr("split_feature", np.int32, ni)
             t.split_feature_inner[:ni] = t.split_feature[:ni]
             t.split_gain[:ni] = read_arr("split_gain", np.float32, ni)

@@ -19,7 +19,8 @@ Knobs:
 from .compile_cache import configure_compile_cache
 from .events import (EventLogger, emit_event, get_event_logger,
                      set_event_logger)
-from .hostio import AsyncWriter, flush_host_io, install_sigterm_flush
+from .hostio import (AsyncWriter, clear_preemption_hook, flush_host_io,
+                     install_sigterm_flush, set_preemption_hook)
 from .registry import MetricsRegistry, global_registry, process_rank
 from .watchdog import (RecompileDetector, sample_device_memory,
                        update_memory_gauges)
@@ -28,6 +29,7 @@ __all__ = [
     "AsyncWriter", "configure_compile_cache",
     "EventLogger", "emit_event", "get_event_logger", "set_event_logger",
     "flush_host_io", "install_sigterm_flush",
+    "set_preemption_hook", "clear_preemption_hook",
     "MetricsRegistry", "global_registry", "process_rank",
     "RecompileDetector", "sample_device_memory", "update_memory_gauges",
 ]

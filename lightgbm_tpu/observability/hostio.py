@@ -122,11 +122,49 @@ class AsyncWriter:
 
 
 # --------------------------------------------------------------------------
-# SIGTERM flush: a supervisor kill must never drop the final events that
-# would explain the failure
+# SIGTERM: preemption notice handling.  A supervisor kill must never drop
+# the final events that would explain the failure, and a preemption
+# notice with a grace window should not cost completed work either — the
+# run-scoped preemption hook (engine.train) captures an out-of-band
+# checkpoint before the signal is re-delivered (docs/Reliability.md).
 # --------------------------------------------------------------------------
 
 _sigterm_installed = False
+
+# run-scoped preemption hook: a zero-arg callable (engine.train's
+# checkpoint-on-demand closure) installed for the duration of a train()
+# call.  Kept out of the signal layer's signature on purpose: the
+# handler is installed once per process, the hook swaps per run.
+_preempt_hook = None
+
+
+def set_preemption_hook(fn) -> None:
+    """Install the callable the SIGTERM handler runs BEFORE flushing and
+    re-delivering — the engine's bounded checkpoint-on-demand."""
+    global _preempt_hook
+    _preempt_hook = fn
+
+
+def clear_preemption_hook() -> None:
+    global _preempt_hook
+    _preempt_hook = None
+
+
+def finish_preemption() -> None:
+    """Terminal half of preemption handling: final `sigterm` event,
+    bounded host-I/O flush, then restore the default disposition and
+    re-deliver — the exit status stays "killed by SIGTERM" (143), which
+    supervisors classify as *preempt*.  Called by the SIGTERM handler
+    directly, or by the engine's iteration boundary when the save was
+    deferred past a mid-update signal."""
+    from .events import emit_event
+    try:
+        emit_event("sigterm", pid=os.getpid())
+    except Exception:  # noqa: BLE001
+        pass
+    flush_host_io(timeout=5.0)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
 
 
 def flush_host_io(timeout: float = 5.0) -> None:
@@ -145,27 +183,39 @@ def flush_host_io(timeout: float = 5.0) -> None:
 
 
 def install_sigterm_flush() -> bool:
-    """Install a SIGTERM handler that emits a final `sigterm` event,
-    drains the async host-I/O queue (bounded wait) and then re-raises
-    the default termination — so a worker killed by the supervisor dies
-    with a COMPLETE event log instead of losing the tail that would have
-    explained the failure.  Idempotent; returns False when it cannot be
-    installed (non-main thread, platforms without SIGTERM handling)."""
+    """Install a SIGTERM handler that treats the signal as a PREEMPTION
+    NOTICE: run the preemption hook (when a train() call installed one —
+    it captures an out-of-band checkpoint inside its grace budget and
+    emits a `preempt` event), emit a final `sigterm` event, drain the
+    async host-I/O queue (bounded wait), then re-raise the default
+    termination — so a preempted worker dies with its completed work
+    checkpointed and a COMPLETE event log, and its exit status is still
+    "killed by SIGTERM" (143), which `classify_returncode` maps to
+    *preempt*, distinct from crash/hang.  Idempotent; returns False when
+    it cannot be installed (non-main thread, platforms without SIGTERM
+    handling)."""
     global _sigterm_installed
     if _sigterm_installed:
         return True
 
     def _handler(signum, frame):
-        from .events import emit_event
-        try:
-            emit_event("sigterm", pid=os.getpid())
-        except Exception:  # noqa: BLE001
-            pass
-        flush_host_io(timeout=5.0)
-        # restore default disposition and re-deliver so the exit status
-        # is still "killed by SIGTERM" (143), which supervisors expect
-        signal.signal(signal.SIGTERM, signal.SIG_DFL)
-        os.kill(os.getpid(), signal.SIGTERM)
+        hook = _preempt_hook
+        if hook is not None:
+            # CPython delivers signals on the main thread, which IS the
+            # training thread here — so the hook's state capture (incl.
+            # the score-buffer D2H) runs exactly where the PR-5
+            # capture/write split expects it to.  A False return means
+            # the signal landed MID-UPDATE (model/scores/iteration are
+            # not a consistent triple): the hook has queued the save for
+            # the iteration boundary, where the engine finishes it and
+            # calls finish_preemption() itself — exiting here would
+            # checkpoint a torn state.
+            try:
+                if hook() is False:
+                    return
+            except Exception as e:  # noqa: BLE001 - dying anyway; flush next
+                log.warning(f"Preemption checkpoint hook failed: {e}")
+        finish_preemption()
 
     try:
         signal.signal(signal.SIGTERM, _handler)

@@ -12,8 +12,10 @@ from .binning import merged_bin_mappers, sample_rows
 from .data_parallel import (data_parallel_shardings, grow_params_for_mesh,
                             make_mesh, make_sharded_wave_fn,
                             shard_for_data_parallel)
+from .elastic import ReshardPlan, ShardSegment, reshard_plan, rows_of
 
 __all__ = [
     "merged_bin_mappers", "sample_rows", "data_parallel_shardings",
     "grow_params_for_mesh", "make_mesh", "make_sharded_wave_fn",
-    "shard_for_data_parallel"]
+    "shard_for_data_parallel",
+    "ReshardPlan", "ShardSegment", "reshard_plan", "rows_of"]

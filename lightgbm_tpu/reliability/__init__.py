@@ -31,9 +31,12 @@ class NonFiniteError(LightGBMError):
 
 from . import faults  # noqa: E402
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: E402
+from .elastic import ElasticDecision, ElasticPolicy  # noqa: E402
+from .faults import WORKER_LOST_EXIT_CODE  # noqa: E402
 from .guard import (DEGRADE_LADDER, STALL_EXIT_CODE,  # noqa: E402
                     RunGuard, classify_returncode)
 
 __all__ = ["Checkpoint", "CheckpointManager", "NonFiniteError", "faults",
            "RunGuard", "STALL_EXIT_CODE", "DEGRADE_LADDER",
-           "classify_returncode"]
+           "classify_returncode", "ElasticDecision", "ElasticPolicy",
+           "WORKER_LOST_EXIT_CODE"]

@@ -14,6 +14,16 @@ Every write is atomic (temp file + os.replace) and the manifest is
 written last, so a crash mid-checkpoint leaves the previous checkpoint
 fully intact.  Rotation keeps the newest `keep_last` checkpoints.
 
+Integrity (docs/Reliability.md §Checkpoint integrity): the manifest
+records a SHA-256 digest per artifact for every retained generation
+(`"generations"`, format 2).  Resume verifies the newest generation's
+digests before trusting it; a torn or bit-flipped checkpoint is
+QUARANTINED (artifacts renamed `*.corrupt-<ts>`, generation dropped
+from the manifest) and resume falls back to the previous rotation
+generation with a structured `ckpt_fallback` event — instead of
+crashing on a half-written npz or, worse, silently training from a
+corrupt score buffer.  Format-1 manifests (no digests) stay loadable.
+
 Resume semantics vs `init_model`: `init_model` adopts a model's trees
 and re-seeds scores from its predictions (good enough for continued
 training on *new* data); a checkpoint resume additionally restores the
@@ -28,9 +38,10 @@ import hashlib
 import io
 import json
 import os
+import time
 import zipfile
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,7 +49,7 @@ from ..utils import atomic_write_bytes, atomic_write_text, log
 from . import faults
 
 MANIFEST = "manifest.json"
-_FORMAT = 1
+_FORMAT = 2
 
 # knobs that do not affect the trained model: a checkpoint taken with a
 # different output path, verbosity, telemetry or serving configuration
@@ -56,6 +67,9 @@ _HASH_EXCLUDE = frozenset((
     # attempts; all are model-neutral perf/telemetry knobs, and a
     # degraded relaunch MUST still resume the interrupted checkpoint
     "tpu_donate_buffers", "auto_degrade", "stall_floor_s", "stall_factor",
+    # elastic recovery knobs (docs/Reliability.md): a shrunken or
+    # preempted relaunch must still resume the interrupted checkpoint
+    "preempt_ckpt_grace_s", "elastic_rank_grace_s", "elastic_min_machines",
 ))
 
 
@@ -69,12 +83,48 @@ def hash_params(params: Dict[str, Any]) -> str:
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def _sha256_file(path: str) -> Optional[str]:
+    """Streaming SHA-256 of a file, None when unreadable."""
+    try:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    except OSError:
+        return None
+
+
 @dataclass
 class Checkpoint:
     iteration: int
     model_path: str
     state_path: Optional[str]
     params_hash: Optional[str]
+    # per-artifact SHA-256 digests keyed by basename (format-2
+    # manifests); None for legacy checkpoints, which skip verification
+    digests: Optional[Dict[str, str]] = None
+    num_rows: Optional[int] = None
+
+    def verify(self) -> Tuple[bool, str]:
+        """Recompute artifact digests against the manifest's record.
+        Legacy checkpoints (no digests) pass vacuously — lenient, like
+        the manifest handling everywhere else in this module."""
+        if not self.digests:
+            return True, "no digests recorded (legacy checkpoint)"
+        for path in (self.model_path, self.state_path):
+            if not path:
+                continue
+            want = self.digests.get(os.path.basename(path))
+            if want is None:
+                continue
+            have = _sha256_file(path)
+            if have is None:
+                return False, f"{os.path.basename(path)}: unreadable"
+            if have != want:
+                return False, (f"{os.path.basename(path)}: digest mismatch "
+                               f"(manifest {want[:12]}…, disk {have[:12]}…)")
+        return True, "ok"
 
     def load_state(self) -> Optional[Dict[str, np.ndarray]]:
         if not self.state_path or not os.path.exists(self.state_path):
@@ -125,6 +175,23 @@ class CheckpointManager:
         self.params_hash = hash_params(params) if params is not None else None
         self.writer = writer
         os.makedirs(self.dir, exist_ok=True)
+        # per-generation manifest records {iteration, model, state,
+        # digests, num_rows}, oldest -> newest; reloaded from an
+        # existing manifest so a resumed process keeps the history it
+        # needs for digest verification and generation fallback
+        self._generations: List[Dict[str, Any]] = self._load_generations()
+
+    def _load_generations(self) -> List[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.dir, MANIFEST)) as f:
+                m = json.load(f)
+            gens = m.get("generations")
+            if isinstance(gens, list):
+                return [g for g in gens if isinstance(g, dict)
+                        and "iteration" in g and "model" in g]
+        except (OSError, ValueError):
+            pass
+        return []
 
     # ------------------------------------------------------------- save
     def _name(self, iteration: int, ext: str) -> str:
@@ -144,19 +211,21 @@ class CheckpointManager:
             it = int(iteration)
             model_txt = booster.model_to_string(num_iteration=-1)
             state = None
+            num_rows = None
             gbdt = getattr(booster, "_gbdt", None)
             if gbdt is not None and hasattr(gbdt, "capture_train_state"):
                 state = gbdt.capture_train_state(
                     async_copy=self.writer is not None)
+                num_rows = int(getattr(gbdt, "num_data", 0)) or None
             ck = Checkpoint(it, self._name(it, "txt"),
                             self._name(it, "npz") if state is not None
-                            else None, self.params_hash)
+                            else None, self.params_hash, num_rows=num_rows)
             if self.writer is not None:
                 self.writer.submit(self._write_reporting, it, model_txt,
-                                   state, ck, on_done)
+                                   state, ck, on_done, num_rows)
                 return ck
             try:
-                self._write(it, model_txt, state)
+                self._write(it, model_txt, state, num_rows)
             except OSError as e:
                 if on_done is not None:
                     on_done(False, e, ck)
@@ -166,12 +235,47 @@ class CheckpointManager:
             on_done(True, None, ck)
         return ck
 
-    def _write_reporting(self, it, model_txt, state, ck, on_done) -> None:
+    def save_now(self, booster, iteration: int,
+                 grace_s: Optional[float] = None) -> Optional[Checkpoint]:
+        """Out-of-band SYNCHRONOUS checkpoint for the preemption handler
+        (docs/Reliability.md §Preemption): capture on the calling
+        (training) thread, write without the AsyncWriter — whose queue
+        the dying process may never drain — and keep the whole save
+        inside `grace_s`: when the capture alone has eaten the budget,
+        the exact-state npz is dropped and the model text (which still
+        resumes, predict-seeded) is written alone.  Returns None when
+        there is nothing worth saving (no completed iteration)."""
+        it = int(iteration)
+        if it <= 0:
+            return None
+        t0 = time.monotonic()
+        # serialize exactly `it` iterations: the pipelined engine may
+        # hold trees past the declared boundary, and a checkpoint whose
+        # model text disagrees with its iteration cannot resume exactly
+        model_txt = booster.model_to_string(num_iteration=it)
+        state = None
+        num_rows = None
+        gbdt = getattr(booster, "_gbdt", None)
+        if gbdt is not None and hasattr(gbdt, "capture_train_state"):
+            state = gbdt.capture_train_state(async_copy=False)
+            num_rows = int(getattr(gbdt, "num_data", 0)) or None
+        if grace_s is not None and time.monotonic() - t0 > float(grace_s):
+            log.warning(f"Preemption checkpoint capture overran the "
+                        f"{grace_s:.1f}s grace budget; writing model text "
+                        "without the exact-state npz")
+            state = None
+        self._write(it, model_txt, state, num_rows)
+        return Checkpoint(it, self._name(it, "txt"),
+                          self._name(it, "npz") if state is not None
+                          else None, self.params_hash, num_rows=num_rows)
+
+    def _write_reporting(self, it, model_txt, state, ck, on_done,
+                         num_rows=None) -> None:
         """Worker-side write wrapper: route the outcome through on_done
         and swallow the failure (reliability contract: a lost checkpoint
         must never kill a long run)."""
         try:
-            self._write(it, model_txt, state)
+            self._write(it, model_txt, state, num_rows)
         except OSError as e:
             if on_done is not None:
                 on_done(False, e, ck)
@@ -182,25 +286,58 @@ class CheckpointManager:
         if on_done is not None:
             on_done(True, None, ck)
 
-    def _write(self, it: int, model_txt: str, state) -> None:
+    def _write(self, it: int, model_txt: str, state,
+               num_rows: Optional[int] = None) -> None:
         """Serialize + atomically rename one captured checkpoint (runs
-        on the writer thread in async mode)."""
+        on the writer thread in async mode).  Digests are computed over
+        the exact bytes handed to the atomic writer, so a later
+        mismatch can only mean on-disk damage, never a race."""
         faults.maybe_ckpt_write_fail(it)
         model_path = self._name(it, "txt")
-        atomic_write_text(model_path, model_txt)
+        model_bytes = model_txt.encode()
+        atomic_write_bytes(model_path, model_bytes)
+        digests = {os.path.basename(model_path):
+                   hashlib.sha256(model_bytes).hexdigest()}
         state_path = None
         if state is not None:
             state_path = self._name(it, "npz")
-            atomic_write_bytes(state_path, _state_bytes(state))
-        manifest = {"format": _FORMAT, "iteration": it,
-                    "model": os.path.basename(model_path),
-                    "state": (os.path.basename(state_path)
-                              if state_path else None),
-                    "params_hash": self.params_hash}
+            sbytes = _state_bytes(state)
+            atomic_write_bytes(state_path, sbytes)
+            digests[os.path.basename(state_path)] = \
+                hashlib.sha256(sbytes).hexdigest()
+        entry = {"iteration": it,
+                 "model": os.path.basename(model_path),
+                 "state": (os.path.basename(state_path)
+                           if state_path else None),
+                 "digests": digests, "num_rows": num_rows,
+                 "params_hash": self.params_hash}
+        self._generations = sorted(
+            [g for g in self._generations if g.get("iteration") != it]
+            + [entry], key=lambda g: g["iteration"])[-self.keep_last:]
+        self._write_manifest()
+        self._rotate()
+        # post-landing damage injection (ckpt_corrupt drill): the
+        # manifest now describes a healthy write the disk no longer holds
+        if faults.active():
+            faults.maybe_ckpt_corrupt(it, model_path, state_path)
+        log.debug(f"Checkpoint written at iteration {it} -> {model_path}")
+
+    def _write_manifest(self) -> None:
+        if not self._generations:
+            try:
+                os.unlink(os.path.join(self.dir, MANIFEST))
+            except OSError:
+                pass
+            return
+        newest = self._generations[-1]
+        manifest = {"format": _FORMAT, "iteration": newest["iteration"],
+                    "model": newest["model"], "state": newest["state"],
+                    "params_hash": self.params_hash,
+                    "num_rows": newest.get("num_rows"),
+                    "digests": newest.get("digests"),
+                    "generations": self._generations}
         atomic_write_text(os.path.join(self.dir, MANIFEST),
                           json.dumps(manifest, indent=1))
-        self._rotate()
-        log.debug(f"Checkpoint written at iteration {it} -> {model_path}")
 
     def _rotate(self) -> None:
         models = sorted(glob.glob(os.path.join(self.dir, "ckpt_*.txt")))
@@ -212,6 +349,26 @@ class CheckpointManager:
                     pass
 
     # ----------------------------------------------------------- latest
+    def _ck_from_entry(self, g: Dict[str, Any]) -> Checkpoint:
+        return Checkpoint(
+            int(g["iteration"]), os.path.join(self.dir, g["model"]),
+            (os.path.join(self.dir, g["state"]) if g.get("state") else None),
+            g.get("params_hash", self.params_hash),
+            digests=g.get("digests"), num_rows=g.get("num_rows"))
+
+    def _candidates(self) -> List[Checkpoint]:
+        """Resumable candidates, newest first: the manifest's retained
+        generations when available, else the single newest checkpoint
+        the manifest or a directory scan yields (legacy layouts)."""
+        # re-read: another process (async writer, preempt handler,
+        # previous attempt) may have advanced the manifest on disk
+        self._generations = self._load_generations() or self._generations
+        if self._generations:
+            return [self._ck_from_entry(g)
+                    for g in reversed(self._generations)]
+        ck = self.latest()
+        return [ck] if ck is not None else []
+
     def latest(self) -> Optional[Checkpoint]:
         """Newest complete checkpoint, or None.  Prefers the manifest;
         falls back to scanning ckpt_*.txt when the manifest is missing
@@ -226,7 +383,9 @@ class CheckpointManager:
                     state = (os.path.join(self.dir, m["state"])
                              if m.get("state") else None)
                     return Checkpoint(int(m["iteration"]), model, state,
-                                      m.get("params_hash"))
+                                      m.get("params_hash"),
+                                      digests=m.get("digests"),
+                                      num_rows=m.get("num_rows"))
                 log.warning(f"Checkpoint manifest points at missing file "
                             f"{model}; scanning {self.dir} instead")
             except (OSError, ValueError, KeyError) as e:
@@ -244,15 +403,40 @@ class CheckpointManager:
         return Checkpoint(it, model, state if os.path.exists(state) else None,
                           None)
 
+    def quarantine(self, ck: Checkpoint, reason: str) -> None:
+        """Move a failed-verification generation out of the resume path:
+        artifacts renamed `*.corrupt-<ts>` (kept for forensics, invisible
+        to the ckpt_*.txt scan) and the generation dropped from the
+        manifest, so neither this process nor the next one can resume
+        into the damage."""
+        ts = int(time.time())
+        for path in (ck.model_path, ck.state_path):
+            if not path or not os.path.exists(path):
+                continue
+            try:
+                os.replace(path, f"{path}.corrupt-{ts}")
+            except OSError as e:
+                log.warning(f"Could not quarantine {path}: {e}")
+        self._generations = [g for g in self._generations
+                             if int(g.get("iteration", -1)) != ck.iteration]
+        self._write_manifest()
+        log.warning(f"Quarantined corrupt checkpoint at iteration "
+                    f"{ck.iteration} in {self.dir}: {reason}")
+
     def resumable(self, params: Optional[Dict[str, Any]] = None
                   ) -> Optional[Checkpoint]:
-        """latest(), gated on a params-hash match: a checkpoint from a
-        different configuration is reported and ignored."""
-        ck = self.latest()
-        if ck is None:
+        """Newest VERIFIED checkpoint, gated on a params-hash match: a
+        checkpoint from a different configuration is reported and
+        ignored; a corrupt one (manifest digest mismatch — torn write,
+        bad disk, injected `ckpt_corrupt`) is quarantined and resume
+        falls back to the previous rotation generation, emitting one
+        structured `ckpt_fallback` event per generation skipped."""
+        candidates = self._candidates()
+        if not candidates:
             return None
         want = (hash_params(params) if params is not None
                 else self.params_hash)
+        ck = candidates[0]
         if ck.params_hash is not None and want is not None \
                 and ck.params_hash != want:
             log.warning(
@@ -261,4 +445,28 @@ class CheckpointManager:
                 f"parameters (hash {ck.params_hash} != {want}). Delete the "
                 f"directory or pass resume=False to start over.")
             return None
-        return ck
+        skipped: List[Tuple[Checkpoint, str]] = []
+        winner = None
+        for ck in candidates:
+            ok, detail = ck.verify()
+            if ok:
+                winner = ck
+                break
+            self.quarantine(ck, detail)
+            skipped.append((ck, detail))
+        if skipped:
+            from ..observability import emit_event, global_registry
+            for bad, detail in skipped:
+                global_registry.inc("ckpt_fallbacks")
+                emit_event("ckpt_fallback", from_iteration=bad.iteration,
+                           to_iteration=(winner.iteration
+                                         if winner is not None else None),
+                           reason=detail)
+            log.warning(
+                f"Checkpoint integrity: quarantined "
+                f"{len(skipped)} corrupt generation(s) in {self.dir}; "
+                + (f"resuming from generation at iteration "
+                   f"{winner.iteration} instead"
+                   if winner is not None else
+                   "no intact generation remains — starting over"))
+        return winner

@@ -17,6 +17,16 @@ the fault).  Kinds:
 * `collective_stall@2` — wedge forever immediately BEFORE the grow
   program dispatch; rank-gated, it models one rank entering a
   collective late so every peer blocks inside psum
+* `ckpt_corrupt@4`    — AFTER the iteration-4 checkpoint lands on disk
+  (manifest included), truncate or bit-flip its newest artifact — the
+  torn-write/bad-disk shape the manifest digests exist to catch.
+  `LGBM_TPU_FAULT_CORRUPT=truncate|bitflip` picks the damage (default
+  truncate; bitflip targets the state npz when one exists)
+* `worker_lost@3`     — permanent rank loss: write a tombstone file
+  keyed by (rank, world size) and `os._exit(WORKER_LOST_EXIT_CODE)`;
+  on every relaunch at the SAME world size the worker main finds its
+  tombstone and refuses to start, so only an elastic shrink (smaller
+  world, different tombstone key) recovers the run
 
 `LGBM_TPU_FAULT_RANK` (optional) restricts firing to one worker: it is
 compared against `LGBM_TPU_FAULT_SELF_RANK`, which the distributed worker
@@ -37,12 +47,17 @@ from typing import List, Optional, Tuple
 from ..utils import log
 
 CRASH_EXIT_CODE = 17
+# a rank that exits with this code has declared itself PERMANENTLY lost
+# (tombstoned): relaunching it at the same world size is futile, so the
+# supervisor's elastic policy shrinks the cluster around it instead
+WORKER_LOST_EXIT_CODE = 77
 
 # parsed (kind, iteration, attempt) specs; None = env not parsed yet
 _specs: Optional[List[Tuple[str, int, int]]] = None
 
 _KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail",
-          "hang", "slow_iter", "collective_stall")
+          "hang", "slow_iter", "collective_stall",
+          "ckpt_corrupt", "worker_lost")
 
 
 def _parse() -> List[Tuple[str, int, int]]:
@@ -187,3 +202,93 @@ def maybe_ckpt_write_fail(iteration: int) -> None:
         _record_injection("ckpt_write_fail", iteration)
         raise OSError(f"[LGBM_TPU_FAULT] injected ckpt_write_fail at "
                       f"iteration {iteration}")
+
+
+def maybe_ckpt_corrupt(iteration: int, model_path: str,
+                       state_path: Optional[str]) -> None:
+    """ckpt_corrupt hook, called AFTER a checkpoint (manifest included)
+    has fully landed: damages the artifact bytes on disk while the
+    manifest's digests still describe the healthy write — exactly what
+    a torn write or bad sector leaves behind.  The integrity check on
+    the next resume must quarantine this generation and fall back."""
+    if not _should_fire("ckpt_corrupt", iteration):
+        return
+    _record_injection("ckpt_corrupt", iteration)
+    mode = os.environ.get("LGBM_TPU_FAULT_CORRUPT", "truncate").strip()
+    target = (state_path if mode == "bitflip" and state_path
+              and os.path.exists(state_path) else model_path)
+    try:
+        size = os.path.getsize(target)
+        if mode == "bitflip":
+            with open(target, "r+b") as f:
+                f.seek(size // 2)
+                byte = f.read(1) or b"\0"
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
+        else:
+            with open(target, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+        log.warning(f"[LGBM_TPU_FAULT] injected ckpt_corrupt ({mode}) at "
+                    f"iteration {iteration}: damaged {target}")
+    except OSError as e:
+        log.warning(f"[LGBM_TPU_FAULT] ckpt_corrupt could not damage "
+                    f"{target}: {e}")
+
+
+def tombstone_path(directory: str, rank: int, world: int) -> str:
+    """Tombstone key: (rank, world size).  A shrink relaunch renumbers
+    the surviving ranks into a smaller world, so its workers never
+    collide with the dead rank's tombstone — which keeps refusing
+    same-world relaunches forever, like the dead host it models."""
+    return os.path.join(os.fspath(directory),
+                        f"tombstone-rank{rank}-of-{world}")
+
+
+def _tombstone_ctx() -> Optional[Tuple[str, int, int]]:
+    d = os.environ.get("LGBM_TPU_TOMBSTONE_DIR")
+    if not d:
+        return None
+    rank = int(os.environ.get("LGBM_TPU_FAULT_SELF_RANK", "0"))
+    world = int(os.environ.get("LGBM_TPU_WORLD_SIZE", "1"))
+    return d, rank, world
+
+
+def check_tombstone() -> None:
+    """Worker-startup gate: a rank that died with worker_lost refuses
+    every relaunch at the same world size (`os._exit`, before any jax
+    initialization, so the refusal is fast and never wedges peers in
+    collectives)."""
+    ctx = _tombstone_ctx()
+    if ctx is None:
+        return
+    d, rank, world = ctx
+    path = tombstone_path(d, rank, world)
+    if os.path.exists(path):
+        sys.stderr.write(f"[LGBM_TPU_FAULT] rank {rank}/{world} is "
+                         f"tombstoned ({path}): refusing relaunch, "
+                         f"exiting {WORKER_LOST_EXIT_CODE}\n")
+        sys.stderr.flush()
+        os._exit(WORKER_LOST_EXIT_CODE)
+
+
+def maybe_worker_lost(iteration: int) -> None:
+    """worker_lost hook (boosting update loop): tombstone this rank and
+    exit WORKER_LOST_EXIT_CODE — a permanent host loss, as opposed to
+    worker_crash's transient one."""
+    if not _should_fire("worker_lost", iteration):
+        return
+    _record_injection("worker_lost", iteration)
+    ctx = _tombstone_ctx()
+    if ctx is not None:
+        d, rank, world = ctx
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(tombstone_path(d, rank, world), "w") as f:
+                f.write(f"worker_lost injected at iteration {iteration}\n")
+        except OSError:
+            pass
+    sys.stderr.write(f"[LGBM_TPU_FAULT] injected worker_lost at iteration "
+                     f"{iteration}: exiting {WORKER_LOST_EXIT_CODE} "
+                     "(permanent)\n")
+    sys.stderr.flush()
+    os._exit(WORKER_LOST_EXIT_CODE)

@@ -43,6 +43,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import atomic_write_text, log
+from .faults import WORKER_LOST_EXIT_CODE
 
 # Distinct from faults.CRASH_EXIT_CODE (17), POSIX signal codes (>128)
 # and timeout(1)'s 124: a process that exits with this code diagnosed
@@ -87,14 +88,22 @@ def stall_file_path(directory: str, rank: int) -> str:
 def classify_returncode(returncode: Optional[int]) -> str:
     """Supervisor-side classification of a worker exit: 'hang' when the
     worker's own watchdog diagnosed a stall (STALL_EXIT_CODE) or an
-    external timeout killed it (None / 124 / SIGKILL-shaped), 'crash'
-    for every other non-zero exit, 'ok' for zero."""
+    external timeout killed it (None / 124 / SIGKILL-shaped); 'preempt'
+    when the worker died of SIGTERM — the preemption-notice shape, where
+    the handler saved an on-demand checkpoint before re-delivering the
+    signal; 'lost' when the rank declared itself permanently gone
+    (tombstoned — relaunching at this world size is futile, shrink
+    instead); 'crash' for every other non-zero exit, 'ok' for zero."""
     if returncode == 0:
         return "ok"
     if returncode == STALL_EXIT_CODE:
         return "hang"
     if returncode is None or returncode == 124:
         return "hang"  # killed for overrunning a deadline: live-but-hung
+    if returncode in (143, -15):
+        return "preempt"  # SIGTERM: a preemption notice, not a bug
+    if returncode == WORKER_LOST_EXIT_CODE:
+        return "lost"
     return "crash"
 
 

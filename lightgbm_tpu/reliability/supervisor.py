@@ -56,6 +56,18 @@ class SuperviseResult:
         only makes sense for hangs."""
         return any(f.kind == "hang" for f in self.failures)
 
+    @property
+    def classification(self) -> str:
+        """Dominant failure kind of the attempt, for event logs and the
+        retry policy: permanence ('lost') outranks hangs, hangs outrank
+        crashes, and 'preempt' only when nothing worse happened (a
+        preempted rank plus a crashed rank is still a crash)."""
+        kinds = {f.kind for f in self.failures}
+        for k in ("lost", "hang", "crash", "preempt", "timeout"):
+            if k in kinds:
+                return k
+        return "timeout" if self.timed_out else "ok"
+
     def describe(self) -> str:
         if self.ok:
             return "all workers exited 0"

@@ -87,12 +87,20 @@ def test_async_matches_sync_byte_for_byte(tmp_path, fault):
     assert hista == hists
     # same checkpoint set, same bytes
     assert _ckpt_files(cka) == _ckpt_files(cks)
+    import re
     for f in _ckpt_files(cka):
         a = open(os.path.join(cka, f), "rb").read()
         s = open(os.path.join(cks, f), "rb").read()
         if f.endswith(".txt") or f == "manifest.json":
             a, s = (_strip_io_params(a.decode()).encode(),
                     _strip_io_params(s.decode()).encode())
+        if f == "manifest.json":
+            # the model-text digest covers the UNstripped bytes, which
+            # include the async knob's own params line — mask digest
+            # values; the artifacts they describe are byte-compared
+            # above, and digest correctness is pinned in test_elastic
+            a, s = (re.sub(rb'"[0-9a-f]{64}"', b'"<sha>"', x)
+                    for x in (a, s))
         assert a == s, f"checkpoint file {f} differs between modes"
     if fault:
         # the injected write failure was absorbed in BOTH modes

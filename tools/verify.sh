@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Single merge gate (ISSUE 8): static analysis + config-doc sync + the
+# elastic chaos drill + full tier-1 — one command, one exit code.
+#
+#   tools/verify.sh          # everything (tier-1 takes ~15 min on CPU)
+#   tools/verify.sh --quick  # skip the full tier-1 (lint + docs + drill)
+#
+# The chaos drill (tests/test_elastic.py) runs FIRST and separately so a
+# recovery-path regression is a named failure at the top of the output,
+# not a dot lost somewhere inside the tier-1 stream.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+step() { echo; echo "==== $* ===="; }
+
+step "tpulint (baseline: no new findings)"
+python -m tools.tpulint lightgbm_tpu --baseline .tpulint_baseline.json \
+    || fail=1
+
+step "tpulint suppression audit"
+python -m tools.tpulint lightgbm_tpu --list-suppressions || fail=1
+
+step "config-doc sync (docs/Parameters.md)"
+python tools/gen_params_doc.py --check || fail=1
+
+step "elastic chaos drill (tests/test_elastic.py)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
+
+if [[ "${1:-}" != "--quick" ]]; then
+    step "tier-1 (full suite, 870 s cap)"
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        2>&1 | tee /tmp/_t1.log
+    rc=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+        | tr -cd . | wc -c)"
+    [[ $rc -ne 0 ]] && fail=1
+fi
+
+echo
+if [[ $fail -eq 0 ]]; then
+    echo "verify: ALL GATES PASSED"
+else
+    echo "verify: FAILED (see the first failing gate above)"
+fi
+exit $fail
