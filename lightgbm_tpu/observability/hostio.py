@@ -93,13 +93,18 @@ class AsyncWriter:
         `timeout` the wait is bounded (polling unfinished_tasks): the
         stall watchdog and the SIGTERM handler flush through here and
         must never wedge on a worker that is itself part of the hang."""
-        if self._thread is None or not self._thread.is_alive():
+        # tpulint: disable-next=signal-handler-safety -- _lock guards only the thread handle swap, never I/O: held for nanoseconds, it cannot wedge the SIGTERM flush
+        with self._lock:
+            t = self._thread
+        if t is None or not t.is_alive():
             return
         if timeout is None:
+            # tpulint: disable-next=signal-handler-safety -- handler/exit-path callers always pass a bounded timeout (flush_host_io, RunGuard); the unbounded branch serves train-end close() on a live worker
             self._q.join()
             return
         deadline = time.monotonic() + float(timeout)
         while time.monotonic() < deadline:
+            # tpulint: disable-next=signal-handler-safety -- the queue condition is held only momentarily by the worker's task_done bookkeeping, and this poll loop is deadline-bounded
             with self._q.all_tasks_done:
                 if self._q.unfinished_tasks == 0:
                     return
@@ -131,6 +136,12 @@ class AsyncWriter:
 
 _sigterm_installed = False
 
+# bound on every terminal-path drain (SIGTERM flush, stall exit): long
+# enough to land a realistic queue on a healthy disk, short enough that
+# a wedged worker cannot eat the whole preemption grace window.  Module
+# level so the reliability drills can shorten it.
+TERMINAL_FLUSH_TIMEOUT_S = 5.0
+
 # run-scoped preemption hook: a zero-arg callable (engine.train's
 # checkpoint-on-demand closure) installed for the duration of a train()
 # call.  Kept out of the signal layer's signature on purpose: the
@@ -142,6 +153,7 @@ def set_preemption_hook(fn) -> None:
     """Install the callable the SIGTERM handler runs BEFORE flushing and
     re-delivering — the engine's bounded checkpoint-on-demand."""
     global _preempt_hook
+    # tpulint: disable-next=thread-shared-state -- atomic pointer rebind on the main thread; the handler snapshots the reference once before calling (a CPython name assignment cannot tear)
     _preempt_hook = fn
 
 
@@ -156,13 +168,23 @@ def finish_preemption() -> None:
     re-deliver — the exit status stays "killed by SIGTERM" (143), which
     supervisors classify as *preempt*.  Called by the SIGTERM handler
     directly, or by the engine's iteration boundary when the save was
-    deferred past a mid-update signal."""
-    from .events import emit_event
+    deferred past a mid-update signal.
+
+    The queued records are drained FIRST (bounded), then the terminal
+    event is written through `emit_event_sync` — NEVER the AsyncWriter:
+    queueing it would block forever on a full bounded queue whose
+    worker is exactly what may be hung (tpulint signal-handler-safety;
+    the bug this replaced put the handler on `queue.put` with no
+    timeout).  With a healthy worker the order is unchanged — every
+    queued record lands, then `sigterm` is the log's last line; with a
+    wedged worker the flush times out and the `sigterm` record still
+    lands."""
+    flush_host_io(timeout=TERMINAL_FLUSH_TIMEOUT_S)
+    from .events import emit_event_sync
     try:
-        emit_event("sigterm", pid=os.getpid())
+        emit_event_sync("sigterm", pid=os.getpid())
     except Exception:  # noqa: BLE001
         pass
-    flush_host_io(timeout=5.0)
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     os.kill(os.getpid(), signal.SIGTERM)
 

@@ -38,6 +38,7 @@ import hashlib
 import io
 import json
 import os
+import threading
 import time
 import zipfile
 from dataclasses import dataclass
@@ -175,6 +176,14 @@ class CheckpointManager:
         self.params_hash = hash_params(params) if params is not None else None
         self.writer = writer
         os.makedirs(self.dir, exist_ok=True)
+        # serializes the generations-list read/modify/write and the
+        # manifest rewrite: in async mode `_write` runs on the writer
+        # thread while `save_now` (the SIGTERM preemption checkpoint)
+        # runs the SAME code on the training thread — unserialized, the
+        # two read-modify-writes race and the manifest can lose a
+        # generation (tpulint thread-shared-state, ISSUE 9).  RLock so
+        # _write may call _write_manifest while holding it.
+        self._gen_lock = threading.RLock()
         # per-generation manifest records {iteration, model, state,
         # digests, num_rows}, oldest -> newest; reloaded from an
         # existing manifest so a resumed process keeps the history it
@@ -311,11 +320,12 @@ class CheckpointManager:
                            if state_path else None),
                  "digests": digests, "num_rows": num_rows,
                  "params_hash": self.params_hash}
-        self._generations = sorted(
-            [g for g in self._generations if g.get("iteration") != it]
-            + [entry], key=lambda g: g["iteration"])[-self.keep_last:]
-        self._write_manifest()
-        self._rotate()
+        with self._gen_lock:
+            self._generations = sorted(
+                [g for g in self._generations if g.get("iteration") != it]
+                + [entry], key=lambda g: g["iteration"])[-self.keep_last:]
+            self._write_manifest()
+            self._rotate()
         # post-landing damage injection (ckpt_corrupt drill): the
         # manifest now describes a healthy write the disk no longer holds
         if faults.active():
@@ -323,21 +333,22 @@ class CheckpointManager:
         log.debug(f"Checkpoint written at iteration {it} -> {model_path}")
 
     def _write_manifest(self) -> None:
-        if not self._generations:
-            try:
-                os.unlink(os.path.join(self.dir, MANIFEST))
-            except OSError:
-                pass
-            return
-        newest = self._generations[-1]
-        manifest = {"format": _FORMAT, "iteration": newest["iteration"],
-                    "model": newest["model"], "state": newest["state"],
-                    "params_hash": self.params_hash,
-                    "num_rows": newest.get("num_rows"),
-                    "digests": newest.get("digests"),
-                    "generations": self._generations}
-        atomic_write_text(os.path.join(self.dir, MANIFEST),
-                          json.dumps(manifest, indent=1))
+        with self._gen_lock:
+            if not self._generations:
+                try:
+                    os.unlink(os.path.join(self.dir, MANIFEST))
+                except OSError:
+                    pass
+                return
+            newest = self._generations[-1]
+            manifest = {"format": _FORMAT, "iteration": newest["iteration"],
+                        "model": newest["model"], "state": newest["state"],
+                        "params_hash": self.params_hash,
+                        "num_rows": newest.get("num_rows"),
+                        "digests": newest.get("digests"),
+                        "generations": self._generations}
+            atomic_write_text(os.path.join(self.dir, MANIFEST),
+                              json.dumps(manifest, indent=1))
 
     def _rotate(self) -> None:
         models = sorted(glob.glob(os.path.join(self.dir, "ckpt_*.txt")))
@@ -362,10 +373,12 @@ class CheckpointManager:
         the manifest or a directory scan yields (legacy layouts)."""
         # re-read: another process (async writer, preempt handler,
         # previous attempt) may have advanced the manifest on disk
-        self._generations = self._load_generations() or self._generations
-        if self._generations:
-            return [self._ck_from_entry(g)
-                    for g in reversed(self._generations)]
+        with self._gen_lock:
+            self._generations = self._load_generations() \
+                or self._generations
+            gens = list(self._generations)
+        if gens:
+            return [self._ck_from_entry(g) for g in reversed(gens)]
         ck = self.latest()
         return [ck] if ck is not None else []
 
@@ -417,9 +430,11 @@ class CheckpointManager:
                 os.replace(path, f"{path}.corrupt-{ts}")
             except OSError as e:
                 log.warning(f"Could not quarantine {path}: {e}")
-        self._generations = [g for g in self._generations
-                             if int(g.get("iteration", -1)) != ck.iteration]
-        self._write_manifest()
+        with self._gen_lock:
+            self._generations = [g for g in self._generations
+                                 if int(g.get("iteration", -1))
+                                 != ck.iteration]
+            self._write_manifest()
         log.warning(f"Quarantined corrupt checkpoint at iteration "
                     f"{ck.iteration} in {self.dir}: {reason}")
 

@@ -87,6 +87,7 @@ def _parse() -> List[Tuple[str, int, int]]:
 def reload() -> None:
     """Re-read LGBM_TPU_FAULT (tests change the env mid-process)."""
     global _specs
+    # tpulint: disable-next=thread-shared-state -- test-only injection state: both sides rebind the same env-derived value, a duplicate parse is idempotent, and one-shot firing tolerates the benign GIL-serialized race
     _specs = None
 
 
@@ -220,12 +221,14 @@ def maybe_ckpt_corrupt(iteration: int, model_path: str,
     try:
         size = os.path.getsize(target)
         if mode == "bitflip":
+            # tpulint: disable-next=atomic-write-discipline -- fault injection: the in-place damage IS the point, modeling the torn write the atomic path prevents
             with open(target, "r+b") as f:
                 f.seek(size // 2)
                 byte = f.read(1) or b"\0"
                 f.seek(size // 2)
                 f.write(bytes([byte[0] ^ 0xFF]))
         else:
+            # tpulint: disable-next=atomic-write-discipline -- fault injection: deliberate truncation models the bad-sector/torn-write shape the manifest digests must catch
             with open(target, "r+b") as f:
                 f.truncate(max(size // 2, 1))
         log.warning(f"[LGBM_TPU_FAULT] injected ckpt_corrupt ({mode}) at "
@@ -242,6 +245,23 @@ def tombstone_path(directory: str, rank: int, world: int) -> str:
     same-world relaunches forever, like the dead host it models."""
     return os.path.join(os.fspath(directory),
                         f"tombstone-rank{rank}-of-{world}")
+
+
+def write_tombstone(directory: str, rank: int, world: int,
+                    reason: str) -> None:
+    """Atomically drop a rank's tombstone.  The file's EXISTENCE is the
+    permanent-loss signal every later relaunch gates on, so it must
+    never be observable half-written: a torn tombstone read as present
+    is correct, but a crash that leaves a zero-byte temp where the
+    marker should be would let a dead rank rejoin (ISSUE 9
+    atomic-write-discipline sweep)."""
+    from ..utils import atomic_write_text
+    try:
+        os.makedirs(directory, exist_ok=True)
+        atomic_write_text(tombstone_path(directory, rank, world),
+                          reason + "\n")
+    except OSError:
+        pass
 
 
 def _tombstone_ctx() -> Optional[Tuple[str, int, int]]:
@@ -281,12 +301,8 @@ def maybe_worker_lost(iteration: int) -> None:
     ctx = _tombstone_ctx()
     if ctx is not None:
         d, rank, world = ctx
-        try:
-            os.makedirs(d, exist_ok=True)
-            with open(tombstone_path(d, rank, world), "w") as f:
-                f.write(f"worker_lost injected at iteration {iteration}\n")
-        except OSError:
-            pass
+        write_tombstone(d, rank, world,
+                        f"worker_lost injected at iteration {iteration}")
     sys.stderr.write(f"[LGBM_TPU_FAULT] injected worker_lost at iteration "
                      f"{iteration}: exiting {WORKER_LOST_EXIT_CODE} "
                      "(permanent)\n")

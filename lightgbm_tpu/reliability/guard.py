@@ -191,6 +191,12 @@ class RunGuard:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tripped = False
+        # guards the tick state shared with the watchdog thread
+        # (_last_tick/_last_iteration/_durations/_tripped): uncontended
+        # acquisition is ~100ns, noise next to one boosting iteration —
+        # and the unsynchronized read/write pair was the first true
+        # finding of tpulint's thread-shared-state sweep (ISSUE 9)
+        self._state_lock = threading.Lock()
 
     # ----------------------------------------------------------- engine API
     def start(self) -> None:
@@ -203,16 +209,17 @@ class RunGuard:
         self._thread.start()
 
     def tick(self, iteration: int) -> None:
-        """One boosting iteration completed.  Cheap: a monotonic read, a
-        deque append and (in supervised runs) one utime on the heartbeat
-        file."""
+        """One boosting iteration completed.  Cheap: a lock, a monotonic
+        read, a deque append and (in supervised runs) one utime on the
+        heartbeat file."""
         now = time.monotonic()
-        prev = self._last_tick if self._last_tick is not None \
-            else self._started_at
-        if prev is not None and self._last_tick is not None:
-            self._durations.append(now - prev)
-        self._last_tick = now
-        self._last_iteration = int(iteration)
+        with self._state_lock:
+            prev = self._last_tick if self._last_tick is not None \
+                else self._started_at
+            if prev is not None and self._last_tick is not None:
+                self._durations.append(now - prev)
+            self._last_tick = now
+            self._last_iteration = int(iteration)
         self._touch_heartbeat()
 
     def update_knobs(self, **knobs) -> None:
@@ -228,20 +235,26 @@ class RunGuard:
 
     @property
     def tripped(self) -> bool:
-        return self._tripped
+        with self._state_lock:
+            return self._tripped
 
     # ------------------------------------------------------------ deadlines
     def median_iter_s(self) -> Optional[float]:
+        with self._state_lock:
+            return self._median_locked()
+
+    def _median_locked(self) -> Optional[float]:
         if not self._durations:
             return None
         s = sorted(self._durations)
         return s[len(s) // 2]
 
     def current_deadline_s(self) -> float:
-        med = self.median_iter_s()
-        if self._last_tick is None or med is None:
-            return self.first_deadline_s
-        return max(self.stall_floor_s, self.stall_factor * med)
+        with self._state_lock:
+            med = self._median_locked()
+            if self._last_tick is None or med is None:
+                return self.first_deadline_s
+            return max(self.stall_floor_s, self.stall_factor * med)
 
     # ------------------------------------------------------------- watchdog
     def _touch_heartbeat(self) -> None:
@@ -255,15 +268,17 @@ class RunGuard:
 
     def _watch(self) -> None:
         while not self._stop.wait(self.poll_interval):
-            anchor = self._last_tick if self._last_tick is not None \
-                else self._started_at
+            with self._state_lock:
+                anchor = self._last_tick if self._last_tick is not None \
+                    else self._started_at
             if anchor is None:
                 continue
             silent_s = time.monotonic() - anchor
             deadline = self.current_deadline_s()
             if silent_s < deadline:
                 continue
-            self._tripped = True
+            with self._state_lock:
+                self._tripped = True
             diagnosis = self.build_diagnosis(silent_s, deadline)
             self.write_diagnosis(diagnosis)
             if self.on_stall is not None:
@@ -283,7 +298,10 @@ class RunGuard:
         lg = get_event_logger()
         if lg is not None:
             last_event = getattr(lg, "last_record", None)
-        med = self.median_iter_s()
+        with self._state_lock:
+            med = self._median_locked()
+            first = self._last_tick is None
+            last_it = self._last_iteration
         return {
             "kind": "stall",
             "rank": self.rank,
@@ -293,8 +311,8 @@ class RunGuard:
             "deadline_s": round(deadline_s, 3),
             "stall_floor_s": self.stall_floor_s,
             "stall_factor": self.stall_factor,
-            "first_iteration": self._last_tick is None,
-            "last_iteration": self._last_iteration,
+            "first_iteration": first,
+            "last_iteration": last_it,
             "median_iter_s": round(med, 6) if med is not None else None,
             "knobs": dict(self.knobs),
             "last_event": last_event,
@@ -329,26 +347,31 @@ class RunGuard:
             sys.stderr.flush()
         except Exception:  # noqa: BLE001
             pass
-        # best-effort event + bounded flush: the writer thread may itself
-        # be wedged, so never wait on it without a deadline
-        try:
-            from ..observability.events import emit_event
-            emit_event("stall", rank=self.rank,
-                       silent_s=diagnosis["silent_s"],
-                       deadline_s=diagnosis["deadline_s"],
-                       last_iteration=diagnosis["last_iteration"])
-        except Exception:  # noqa: BLE001
-            pass
+        # bounded flush FIRST (the writer thread may itself be wedged —
+        # never wait on it without a deadline), then the terminal stall
+        # record bypasses the writer entirely (emit_event_sync: private
+        # handle, no queue — queueing through the AsyncWriter here could
+        # block this exit path forever on a full bounded queue, the
+        # signal-handler-safety hazard)
         if self.writer is not None:
             try:
-                self.writer.flush(timeout=5.0)
+                from ..observability import hostio
+                self.writer.flush(timeout=hostio.TERMINAL_FLUSH_TIMEOUT_S)
             except Exception:  # noqa: BLE001
                 pass
+        try:
+            from ..observability.events import emit_event_sync
+            emit_event_sync("stall", rank=self.rank,
+                            silent_s=diagnosis["silent_s"],
+                            deadline_s=diagnosis["deadline_s"],
+                            last_iteration=diagnosis["last_iteration"])
+        except Exception:  # noqa: BLE001
+            pass
         try:
             from ..observability.events import get_event_logger
             lg = get_event_logger()
             if lg is not None:
-                lg._fh.flush()
+                lg.flush(timeout=1.0)
         except Exception:  # noqa: BLE001
             pass
         os._exit(STALL_EXIT_CODE)

@@ -1,0 +1,337 @@
+"""Regression tests for the ISSUE-9 concurrency sweep.
+
+tpulint v3's signal-safety / lockset / atomic-write families flagged
+real hazards in the reliability stack; each fix here gets a behavioral
+pin:
+
+* the SIGTERM flush and the stall watchdog's exit path used to route
+  their terminal event through the AsyncWriter — a blocking `put` on a
+  bounded queue whose worker may be exactly what is hung.  Both now go
+  through `emit_event_sync` (private O_APPEND handle): the subprocess
+  drills wedge the worker, FILL the queue, and require the process to
+  still die promptly with the terminal record on disk;
+* `CheckpointManager._write` runs on the writer thread in async mode
+  and on the training thread for `save_now` (preemption): the
+  generations read-modify-write is now serialized by `_gen_lock`, so
+  concurrent writers cannot lose a generation from the manifest;
+* `RunGuard.tick` state shared with the watchdog thread is under
+  `_state_lock`;
+* tombstones are written atomically (`faults.write_tombstone`).
+
+No jax needed: everything here is host-side.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from lightgbm_tpu.observability.events import (EventLogger,  # noqa: E402
+                                               set_event_logger)
+from lightgbm_tpu.observability.hostio import AsyncWriter  # noqa: E402
+
+
+def _read_events(tmp_path, rank=0):
+    p = tmp_path / f"events-rank{rank}.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(ln) for ln in p.read_text().splitlines()]
+
+
+def _wedge_and_fill(writer, maxq):
+    """Park the worker on an Event nobody sets, then fill the queue."""
+    gate = threading.Event()
+    writer.submit(gate.wait)
+    deadline = time.monotonic() + 5.0
+    while writer.pending < maxq and time.monotonic() < deadline:
+        # the worker may not have dequeued the gate task yet
+        try:
+            writer._q.put_nowait((lambda: None, (), {}))
+        except Exception:
+            time.sleep(0.01)
+    return gate
+
+
+# ----------------------------------------------------------- emit_sync
+def test_emit_sync_bypasses_wedged_writer(tmp_path):
+    """emit_sync must return promptly and land its record even when the
+    AsyncWriter worker is wedged and the bounded queue is FULL — the
+    state in which the old emit_event path blocked forever on put()."""
+    w = AsyncWriter(max_queue=1)
+    lg = EventLogger(str(tmp_path), rank=0, writer=w)
+    gate = _wedge_and_fill(w, 1)
+    try:
+        t0 = time.monotonic()
+        lg.emit_sync("stall", silent_s=1.5)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, f"emit_sync blocked {elapsed:.1f}s"
+        events = [r["event"] for r in _read_events(tmp_path)]
+        assert "stall" in events
+        assert lg.last_record["event"] == "stall"
+    finally:
+        gate.set()
+        w.close()
+        lg.close()
+
+
+def test_emit_sync_no_writer(tmp_path):
+    lg = EventLogger(str(tmp_path), rank=0)
+    lg.emit("iteration", iteration=0)
+    lg.emit_sync("sigterm", pid=123)
+    lg.close()
+    events = [r["event"] for r in _read_events(tmp_path)]
+    assert events == ["iteration", "sigterm"]
+
+
+# ---------------------------------------------------- SIGTERM drill
+@pytest.mark.skipif(not hasattr(signal, "SIGTERM"), reason="no SIGTERM")
+def test_sigterm_exits_promptly_with_wedged_writer(tmp_path):
+    """The preemption-notice handler must never block on the writer
+    queue: with the worker wedged and the queue full, SIGTERM still
+    kills the process within the bounded flush window and the terminal
+    `sigterm` record is on disk.  Before the emit_event_sync fix this
+    drill deadlocked in queue.put and timed out."""
+    code = f"""
+import os, signal, sys, threading, time
+sys.path.insert(0, {_REPO!r})
+from lightgbm_tpu.observability.hostio import AsyncWriter, \\
+    install_sigterm_flush
+from lightgbm_tpu.observability.events import EventLogger, \\
+    set_event_logger
+from lightgbm_tpu.observability import hostio
+hostio.TERMINAL_FLUSH_TIMEOUT_S = 0.5   # shorten the drill's wait
+
+w = AsyncWriter(max_queue=1)
+lg = EventLogger({str(tmp_path)!r}, rank=0, writer=w)
+set_event_logger(lg)
+assert install_sigterm_flush()
+gate = threading.Event()
+w.submit(gate.wait)                      # wedge the worker
+deadline = time.monotonic() + 5.0
+while time.monotonic() < deadline:       # fill the bounded queue
+    try:
+        w._q.put_nowait((lambda: None, (), {{}}))
+    except Exception:
+        break
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(60)                           # never reached
+"""
+    t0 = time.monotonic()
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=45)
+    elapsed = time.monotonic() - t0
+    assert res.returncode in (-signal.SIGTERM, 128 + signal.SIGTERM), \
+        f"rc={res.returncode}\n{res.stderr}"
+    # bounded: the 5 s flush timeout plus generous slack, nowhere near
+    # the 60 s sleep (or the 45 s subprocess cap) a deadlock would eat
+    assert elapsed < 30, f"SIGTERM handling took {elapsed:.1f}s"
+    events = [r["event"] for r in _read_events(tmp_path)]
+    assert events[-1] == "sigterm", events[-5:]
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGTERM"), reason="no SIGTERM")
+def test_stall_exit_path_with_wedged_writer(tmp_path):
+    """Same contract for the watchdog's exit path: a tripped RunGuard
+    with a wedged writer and a full queue must still write its stall
+    diagnosis, emit the terminal `stall` record synchronously, and exit
+    STALL_EXIT_CODE — not hang inside its own hang handler."""
+    code = f"""
+import os, sys, threading, time
+sys.path.insert(0, {_REPO!r})
+from lightgbm_tpu.observability.hostio import AsyncWriter
+from lightgbm_tpu.observability.events import EventLogger, \\
+    set_event_logger
+from lightgbm_tpu.observability import hostio
+from lightgbm_tpu.reliability.guard import RunGuard
+hostio.TERMINAL_FLUSH_TIMEOUT_S = 0.5   # shorten the drill's wait
+
+w = AsyncWriter(max_queue=1)
+lg = EventLogger({str(tmp_path)!r}, rank=0, writer=w)
+set_event_logger(lg)
+gate = threading.Event()
+w.submit(gate.wait)
+deadline = time.monotonic() + 5.0
+while time.monotonic() < deadline:
+    try:
+        w._q.put_nowait((lambda: None, (), {{}}))
+    except Exception:
+        break
+g = RunGuard({str(tmp_path)!r}, rank=0, stall_floor_s=0.2,
+             stall_factor=1.0, first_deadline_s=0.4, writer=w,
+             poll_interval=0.05)
+g.start()
+time.sleep(60)                           # never reached: watchdog exits
+"""
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=45)
+    from lightgbm_tpu.reliability.guard import STALL_EXIT_CODE
+    assert res.returncode == STALL_EXIT_CODE, \
+        f"rc={res.returncode}\n{res.stderr}"
+    diag = json.loads((tmp_path / "stall-rank0.json").read_text())
+    assert diag["kind"] == "stall" and diag["exit_code"] == STALL_EXIT_CODE
+    events = [r["event"] for r in _read_events(tmp_path)]
+    assert "stall" in events, events
+
+
+# ------------------------------------------------ checkpoint gen lock
+class _FakeBooster:
+    def __init__(self, tag="t"):
+        self.tag = tag
+
+    def model_to_string(self, num_iteration=None, **kw):
+        return f"tree_{self.tag}_{num_iteration}\n"
+
+
+def test_checkpoint_generations_survive_concurrent_writers(tmp_path):
+    """Hammer `_write` from two threads with distinct iterations: the
+    `_gen_lock` serialization must keep EVERY generation in the
+    manifest.  Without the lock the read-modify-write of
+    `_generations` loses entries (exactly the async-save vs
+    preemption-save_now race)."""
+    from lightgbm_tpu.reliability.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep_last=64,
+                            params={"a": 1})
+    start = threading.Barrier(2)
+    errs = []
+
+    def writer(base):
+        try:
+            start.wait(timeout=10)
+            for i in range(20):
+                mgr._write(base + i, f"tree {base + i}\n", None, None)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer, args=(b,))
+          for b in (100, 200)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    its = sorted(int(g["iteration"]) for g in manifest["generations"])
+    assert its == sorted(list(range(100, 120)) + list(range(200, 220)))
+
+
+def test_checkpoint_async_save_and_save_now_both_land(tmp_path):
+    """A queued async save plus an out-of-band save_now (the preemption
+    shape) must BOTH end up in the manifest, in iteration order."""
+    from lightgbm_tpu.reliability.checkpoint import CheckpointManager
+    w = AsyncWriter()
+    mgr = CheckpointManager(str(tmp_path), keep_last=8, params={"a": 1},
+                            writer=w)
+    gate = threading.Event()
+    w.submit(gate.wait)                 # hold the async save back
+    mgr.save(_FakeBooster(), 5)         # queued behind the gate
+    ck = mgr.save_now(_FakeBooster(), 6)   # synchronous, on this thread
+    assert ck is not None and ck.iteration == 6
+    gate.set()
+    w.close()
+    its = sorted(int(g["iteration"]) for g in mgr._generations)
+    assert its == [5, 6]
+    resumed = mgr.resumable({"a": 1})
+    assert resumed is not None and resumed.iteration == 6
+
+
+# ------------------------------------------------- RunGuard state lock
+def test_runguard_tick_is_thread_safe(tmp_path):
+    """Two threads hammering tick() while the watchdog polls at 100 Hz:
+    no trip, no exception, and the rolling median stays sane."""
+    from lightgbm_tpu.reliability.guard import RunGuard
+    g = RunGuard(str(tmp_path), rank=0, stall_floor_s=30.0,
+                 poll_interval=0.01)
+    g.start()
+    errs = []
+
+    def hammer():
+        try:
+            for i in range(400):
+                g.tick(i)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    med = g.median_iter_s()
+    tripped = g.tripped
+    g.stop()
+    assert not errs
+    assert not tripped
+    assert med is not None and med < 1.0
+
+
+# ---------------------------------------------------- atomic tombstone
+def test_tombstone_written_atomically(tmp_path):
+    from lightgbm_tpu.reliability import faults
+    faults.write_tombstone(str(tmp_path), 2, 8, "worker_lost at iter 3")
+    p = faults.tombstone_path(str(tmp_path), 2, 8)
+    assert open(p).read() == "worker_lost at iter 3\n"
+    # no temp-file droppings: the write went through temp + os.replace
+    assert os.listdir(tmp_path) == [os.path.basename(p)]
+
+
+def test_sigterm_event_still_last_with_healthy_worker(tmp_path):
+    """Ordering pin: with a HEALTHY worker the terminal record must
+    still be the log's last line — the bounded flush drains the queue
+    before emit_event_sync appends `sigterm`."""
+    code = f"""
+import os, signal, sys, time
+sys.path.insert(0, {_REPO!r})
+from lightgbm_tpu.observability.hostio import AsyncWriter, \\
+    install_sigterm_flush
+from lightgbm_tpu.observability.events import EventLogger, \\
+    set_event_logger
+w = AsyncWriter()
+lg = EventLogger({str(tmp_path)!r}, rank=0, writer=w)
+set_event_logger(lg)
+assert install_sigterm_flush()
+for i in range(50):
+    lg.emit("iteration", iteration=i)
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(60)
+"""
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=45)
+    assert res.returncode in (-signal.SIGTERM, 128 + signal.SIGTERM), \
+        f"rc={res.returncode}\n{res.stderr}"
+    recs = _read_events(tmp_path)
+    its = [r["iteration"] for r in recs if r["event"] == "iteration"]
+    assert its == list(range(50))
+    assert recs[-1]["event"] == "sigterm"
+
+
+def test_event_log_rotation_serialized_with_sync_emit(tmp_path):
+    """Rotation (writer thread) vs emit_sync (main): no lost lines, no
+    interleaved half-records, across a rotation boundary."""
+    w = AsyncWriter()
+    lg = EventLogger(str(tmp_path), rank=0, rotate_mb=0.0005, writer=w)
+    set_event_logger(lg)
+    try:
+        for i in range(200):
+            lg.emit("iteration", iteration=i, pad="x" * 32)
+            if i % 50 == 0:
+                lg.emit_sync("marker", i=i)
+        w.flush()
+    finally:
+        set_event_logger(None)
+        w.close()
+        lg.close()
+    recs = []
+    for name in sorted(os.listdir(tmp_path)):
+        for ln in (tmp_path / name).read_text().splitlines():
+            recs.append(json.loads(ln))  # every line parses whole
+    its = sorted(r["iteration"] for r in recs if r["event"] == "iteration")
+    assert its == list(range(200))
+    assert sum(1 for r in recs if r["event"] == "marker") == 4

@@ -25,16 +25,32 @@ Rules (docs/StaticAnalysis.md):
 * no-device-put-in-loop    — no H2D transfers in Python loop bodies
 * no-bare-print            — all output through utils.log / event log
 * config-doc-sync          — config.py PARAMS <-> docs/Parameters.md
+* signal-handler-safety    — no unbounded blocking (queue put/join,
+                             lock acquire, event wait) or jax dispatch
+                             reachable from signal handlers / watchdog
+                             exit paths (v3 concurrency roots)
+* thread-shared-state      — lockset race detection: attributes and
+                             globals written on one concurrent root
+                             (thread/handler/main) and accessed on
+                             another with no common lock
+* rng-stream-discipline    — draw-once PRNG keys, no np.random module
+                             state, iteration-keyed seeds (the
+                             byte-exact-resume RNG contract)
+* atomic-write-discipline  — write-mode open() under reliability/ must
+                             use the temp+os.replace atomic writer
 
-Run:  python -m tools.tpulint [package_dir] [--format=json|text|github]
+Run:  python -m tools.tpulint [package_dir]
+      [--format=json|text|github|sarif] [--jobs=N]
       [--baseline=FILE] [--write-baseline=FILE] [--list-suppressions]
 Suppress:  # tpulint: disable=<rule>[,<rule>] -- <justification>
 """
 
 from .core import (Finding, LintContext, Report, Rule, RULES,  # noqa: F401
-                   apply_baseline, baseline_counts, iter_suppressions,
-                   register, run_lint, write_baseline)
+                   apply_baseline, audit_suppressions, baseline_counts,
+                   iter_suppressions, register, run_lint, to_sarif,
+                   write_baseline)
 
 __all__ = ["Finding", "LintContext", "Report", "Rule", "RULES",
-           "apply_baseline", "baseline_counts", "iter_suppressions",
-           "register", "run_lint", "write_baseline"]
+           "apply_baseline", "audit_suppressions", "baseline_counts",
+           "iter_suppressions", "register", "run_lint", "to_sarif",
+           "write_baseline"]

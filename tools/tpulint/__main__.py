@@ -7,8 +7,11 @@ tests/test_tpulint.py; external CI calls this exactly the same way):
     1  unsuppressed/new findings (or a rule/usage error)
 
 Options:
-    --format=text|json|github  report format (github emits workflow
-                               annotations: ::error file=...,line=...)
+    --format=text|json|github|sarif
+                               report format (github emits workflow
+                               annotations ::error file=...,line=...;
+                               sarif emits SARIF 2.1.0 for standard PR
+                               annotation tooling)
     --rules=a,b                run only the named rules
     --list-rules               print the registry and exit
     --baseline=FILE            accept the legacy findings recorded in
@@ -16,7 +19,11 @@ Options:
     --write-baseline=FILE      record the current findings as the
                                baseline and exit 0
     --list-suppressions        audit every `# tpulint: disable` in the
-                               package (path, line, rules, why)
+                               package (path, line, rules, why); runs
+                               the suite and exits 1 on STALE
+                               suppressions that mask nothing
+    --jobs=N                   process-pool width for the per-file rule
+                               passes (default: one per CPU; 1 = serial)
     --no-cache                 disable the mtime-keyed analysis cache
                                (.tpulint_cache.json next to the package)
 """
@@ -24,10 +31,11 @@ Options:
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
-from .core import (RULES, apply_baseline, default_cache_path,
-                   iter_suppressions, run_lint, write_baseline)
+from .core import (RULES, apply_baseline, audit_suppressions,
+                   default_cache_path, run_lint, to_sarif, write_baseline)
 
 
 def _github_line(f) -> str:
@@ -41,8 +49,12 @@ def main(argv=None) -> int:
         description="JAX/TPU-aware static analysis (docs/StaticAnalysis.md)")
     ap.add_argument("package_dir", nargs="?", default="lightgbm_tpu",
                     help="package tree to lint (default: lightgbm_tpu)")
-    ap.add_argument("--format", choices=("text", "json", "github"),
+    ap.add_argument("--format", choices=("text", "json", "github",
+                                         "sarif"),
                     default="text")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="process-pool width for per-file rules "
+                         "(default: one per CPU; 1 = serial)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true")
@@ -64,20 +76,27 @@ def main(argv=None) -> int:
         return 0
 
     if args.list_suppressions:
-        n = 0
-        for path, line, rules, why in sorted(iter_suppressions(
-                args.package_dir)):
+        n = stale = 0
+        cache = (None if args.no_cache
+                 else default_cache_path(args.package_dir))
+        for path, line, rules, why, used in sorted(audit_suppressions(
+                args.package_dir, cache_path=cache)):
             n += 1
+            mark = ""
+            if not used:
+                stale += 1
+                mark = " (STALE: masks no finding — remove it)"
             sys.stdout.write(f"{path}:{line}: [{','.join(rules)}] "
-                             f"{why or '(MISSING JUSTIFICATION)'}\n")
-        sys.stdout.write(f"{n} suppression(s)\n")
-        return 0
+                             f"{why or '(MISSING JUSTIFICATION)'}{mark}\n")
+        sys.stdout.write(f"{n} suppression(s), {stale} stale\n")
+        return 1 if stale else 0
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     cache = None if args.no_cache else default_cache_path(args.package_dir)
     try:
-        report = run_lint(args.package_dir, rules=rules, cache_path=cache)
+        report = run_lint(args.package_dir, rules=rules, cache_path=cache,
+                          jobs=args.jobs)
     except KeyError as e:
         sys.stderr.write(f"tpulint: {e.args[0]}\n")
         return 1
@@ -100,6 +119,10 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         sys.stdout.write(report.to_json() + "\n")
+    elif args.format == "sarif":
+        sys.stdout.write(json.dumps(
+            to_sarif(report, failing if args.baseline else None),
+            indent=2) + "\n")
     elif args.format == "github":
         for f in failing:
             sys.stdout.write(_github_line(f) + "\n")

@@ -84,6 +84,22 @@ _JIT_NAMES = ("jax.jit", "jit")
 _PARTIAL_NAMES = ("functools.partial", "partial")
 
 
+def cached_walk(root: ast.AST) -> List[ast.AST]:
+    """`list(ast.walk(root))`, memoized on the node.  The rules walk the
+    same file and function subtrees many times over; caching the flat
+    node list once per root cut the cold full-package lint measurably
+    (ast.walk's deque/iter_child_nodes machinery dominated the
+    profile)."""
+    lst = getattr(root, "_tpulint_walk", None)
+    if lst is None:
+        lst = list(ast.walk(root))
+        try:
+            root._tpulint_walk = lst  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return lst
+
+
 @dataclass
 class FuncInfo:
     """One function definition (top-level, method, or nested)."""
@@ -118,6 +134,10 @@ class ClassInfo:
     # attr name -> functions possibly bound via `self.attr = ...` /
     # `self.attr[k] = ...` / class-body assignment (grows monotonically)
     attr_funcs: Dict[str, Set[int]] = field(default_factory=dict)
+    # attr name -> dotted constructor it was assigned from
+    # (`self._q = queue.Queue(...)` -> "queue.Queue"): the concurrency
+    # rules use this to recognize lock/queue/event-typed attributes
+    attr_types: Dict[str, str] = field(default_factory=dict)
 
     def find_method(self, name: str) -> Optional[FuncInfo]:
         if name in self.methods:
@@ -133,6 +153,15 @@ class ClassInfo:
         for base in self.bases:
             out |= base.find_attr_funcs(name)
         return out
+
+    def find_attr_type(self, name: str) -> Optional[str]:
+        if name in self.attr_types:
+            return self.attr_types[name]
+        for base in self.bases:
+            t = base.find_attr_type(name)
+            if t is not None:
+                return t
+        return None
 
 
 class ModuleInfo:
@@ -171,7 +200,7 @@ class ModuleInfo:
         return ".".join(base)
 
     def _index(self, tree: ast.AST) -> None:
-        for node in ast.walk(tree):
+        for node in cached_walk(tree):
             if isinstance(node, ast.Import):
                 for al in node.names:
                     self.imports[al.asname or al.name.split(".")[0]] = (
@@ -220,6 +249,17 @@ class ModuleInfo:
         return ".".join([head] + list(reversed(parts)))
 
 
+def module_info_for(ctx, pf) -> ModuleInfo:
+    """One shared ModuleInfo per parsed file (cached on the PyFile): the
+    per-file rules and the package index all read the same parse instead
+    of re-indexing imports/classes once per rule."""
+    mi = getattr(pf, "_tpulint_mi", None)
+    if mi is None:
+        mi = ModuleInfo(pf, ctx.package_name)
+        pf._tpulint_mi = mi  # type: ignore[attr-defined]
+    return mi
+
+
 class PackageIndex:
     """All modules of the linted package + jit roots + class hierarchy +
     value bindings."""
@@ -231,7 +271,7 @@ class PackageIndex:
         # stay hashable across dataclass instances)
         self.funcs_by_id: Dict[int, FuncInfo] = {}
         for pf in ctx.files:
-            mi = ModuleInfo(pf, ctx.package_name)
+            mi = module_info_for(ctx, pf)
             self.modules[mi.dotted] = mi
         self._register_known_funcs()
         self._link_bases()
@@ -288,7 +328,7 @@ class PackageIndex:
         if mi.pf.tree is None:
             return
         # decorated defs (any nesting depth)
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     statics = self._jit_decorator_statics(mi, dec, node)
@@ -352,7 +392,7 @@ class PackageIndex:
                            ) -> Optional[FuncInfo]:
         if name in mi.top_funcs:
             return mi.top_funcs[name]
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name == name:
                 return self._func_for_def(mi, node)
@@ -385,12 +425,12 @@ class PackageIndex:
         params += [p.arg for p in a.args]
         for kw in call.keywords:
             if kw.arg == "static_argnames":
-                for v in ast.walk(kw.value):
+                for v in cached_walk(kw.value):
                     if isinstance(v, ast.Constant) and isinstance(v.value,
                                                                   str):
                         out.add(v.value)
             elif kw.arg == "static_argnums":
-                for v in ast.walk(kw.value):
+                for v in cached_walk(kw.value):
                     if isinstance(v, ast.Constant) and isinstance(v.value,
                                                                   int):
                         if 0 <= v.value < len(params):
@@ -435,7 +475,7 @@ class PackageIndex:
                                     if refs - cur:
                                         cur |= refs
                                         changed = True
-                    for node in ast.walk(ci.node):
+                    for node in cached_walk(ci.node):
                         if not isinstance(node, ast.Assign):
                             continue
                         refs = None
@@ -443,6 +483,11 @@ class PackageIndex:
                             attr = self._self_attr_target(t)
                             if attr is None:
                                 continue
+                            if isinstance(node.value, ast.Call) \
+                                    and attr not in ci.attr_types:
+                                dotted = mi.dotted_of(node.value.func)
+                                if dotted:
+                                    ci.attr_types[attr] = dotted
                             if refs is None:
                                 refs = self.collect_refs(
                                     mi, node.value, ci, None)
@@ -535,14 +580,25 @@ class PackageIndex:
             if ci is not None:
                 m = ci.find_method(expr.attr)
                 return {id(m)} if m is not None else set()
-            # module.func through imports
+            # module.func through imports (plain `import pkg.mod` and the
+            # `from . import mod` module-as-attribute form)
             if isinstance(expr.value, ast.Name):
-                imp = mi.imports.get(expr.value.id)
-                if imp and imp[1] is None:
-                    tgt = self.modules.get(imp[0])
-                    if tgt is not None:
-                        return self.resolve_name(tgt, expr.attr)
+                tgt = self._imported_module(mi, expr.value.id)
+                if tgt is not None:
+                    return self.resolve_name(tgt, expr.attr)
         return set()
+
+    def _imported_module(self, mi: ModuleInfo,
+                         name: str) -> Optional[ModuleInfo]:
+        """The in-package module a bare name denotes: `import x.y` binds
+        x, `from . import mod` binds mod as an attribute of the
+        package."""
+        imp = mi.imports.get(name)
+        if not imp:
+            return None
+        if imp[1] is None:
+            return self.modules.get(imp[0])
+        return self.modules.get(imp[0] + "." + imp[1])
 
     def resolve_name(self, mi: ModuleInfo, name: str,
                      _seen: Optional[Set[Tuple[str, str]]] = None
@@ -575,12 +631,12 @@ class PackageIndex:
         out: Set[int] = set()
         nested: Dict[str, ast.AST] = {}
         if not isinstance(fi.node, ast.Lambda):
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)) \
                         and node is not fi.node:
                     nested.setdefault(node.name, node)
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if isinstance(node, ast.Return) \
                         and isinstance(node.value, ast.Name) \
                         and node.value.id in nested:
@@ -588,6 +644,195 @@ class PackageIndex:
                                                   nested[node.value.id])))
         fi._returned = out  # type: ignore[attr-defined]
         return out
+
+    # ---- v3: concurrency roots ----------------------------------------
+    # The reliability stack's hazards live in code that runs OUTSIDE the
+    # main thread's program order: signal handlers (`signal.signal(sig,
+    # fn)`), watchdog/worker threads (`threading.Thread(target=fn)`), and
+    # callables shipped to another thread for deferred execution
+    # (`writer.submit(self._append, line)`).  These are new ROOT KINDS:
+    # the concurrency rules walk each root's reachable set the same way
+    # the jit rules walk jit roots.
+
+    def _named_funcs(self) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        for mi in self.modules.values():
+            out.extend(mi.top_funcs.values())
+            for ci in mi.top_classes.values():
+                out.extend(ci.methods.values())
+        return out
+
+    def _refs_with_nested(self, mi: ModuleInfo,
+                          owner: Optional[ClassInfo],
+                          nested: Dict[str, ast.AST],
+                          expr: ast.AST) -> Set[int]:
+        """Function refs `expr` may denote, nested defs included (a
+        handler or thread target is very often a closure)."""
+        if isinstance(expr, ast.Name) and expr.id in nested:
+            return {id(self._func_for_def(mi, nested[expr.id]))}
+        return set(self.collect_refs(mi, expr, owner, None))
+
+    def concurrency_roots(self) -> Tuple[List[FuncInfo], List[FuncInfo]]:
+        """(handler_roots, thread_roots) of the whole package.
+
+        * handler roots: callables registered via `signal.signal(sig,
+          fn)` (and any callable argument of `faulthandler.register`);
+        * thread roots: `threading.Thread(target=fn)` targets, plus
+          callables passed to a `.submit(...)` call — the AsyncWriter
+          deferred-execution shape, where the callee runs on the worker
+          thread though no Thread() names it.
+        """
+        cached = getattr(self, "_concur_roots", None)
+        if cached is not None:
+            return cached
+        handler_ids: Set[int] = set()
+        thread_ids: Set[int] = set()
+
+        def scan(mi, owner, nested, body_root):
+            for node in cached_walk(body_root):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = mi.dotted_of(node.func) or ""
+                tail = dotted.rsplit(".", 1)[-1]
+                args = list(node.args) + [kw.value for kw in node.keywords
+                                          if kw.arg != "args"]
+                if dotted in ("signal.signal", "faulthandler.register"):
+                    for a in args:
+                        handler_ids.update(
+                            self._refs_with_nested(mi, owner, nested, a))
+                elif tail == "Thread" and dotted.startswith(
+                        ("threading.", "Thread")):
+                    target = None
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                    if target is None and node.args:
+                        target = node.args[1] if len(node.args) > 1 \
+                            else None
+                    if target is not None:
+                        thread_ids.update(self._refs_with_nested(
+                            mi, owner, nested, target))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit":
+                    for a in args:
+                        thread_ids.update(self._refs_with_nested(
+                            mi, owner, nested, a))
+
+        for fi in self._named_funcs():
+            if fi.node is None or isinstance(fi.node, ast.Lambda):
+                continue
+            nested = {n.name: n for n in cached_walk(fi.node)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and n is not fi.node}
+            scan(fi.module, fi.owner_class, nested, fi.node)
+        for mi in self.modules.values():
+            if mi.pf.tree is None:
+                continue
+            for stmt in mi.pf.tree.body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    scan(mi, None, {}, stmt)
+
+        roots = ([self.func(i) for i in handler_ids],
+                 [self.func(i) for i in thread_ids])
+        self._concur_roots = roots  # type: ignore[attr-defined]
+        return roots
+
+    # method names owned by stdlib containers/strings/files: a duck
+    # step through `.update()` or `.get()` would wire dict calls to
+    # Booster.update and explode the reach with false edges
+    _DUCK_SKIP = {
+        "update", "get", "pop", "popitem", "keys", "values", "items",
+        "setdefault", "clear", "copy", "append", "appendleft", "extend",
+        "insert", "remove", "sort", "reverse", "add", "discard", "union",
+        "split", "rsplit", "splitlines", "strip", "lstrip", "rstrip",
+        "join", "format", "encode", "decode", "startswith", "endswith",
+        "replace", "count", "index", "lower", "upper", "title", "tell",
+        "seek", "read", "readline", "readlines", "search", "match",
+        "group", "groups", "astype", "reshape", "tolist", "item", "sum",
+        "mean", "min", "max", "any", "all",
+    }
+
+    def methods_named(self, name: str) -> List[FuncInfo]:
+        """Every in-package method with this name — the duck-typed
+        fallback resolution the concurrency reach uses for method calls
+        on objects whose class the expression does not reveal
+        (`_current.emit(...)`, `w.flush(...)`).  Over-approximating
+        reach is the right bias for a safety rule; names stdlib
+        containers own (`_DUCK_SKIP`) and names shared by more than a
+        handful of classes are too ambiguous to step through."""
+        table = getattr(self, "_methods_by_name", None)
+        if table is None:
+            table = {}
+            for mi in self.modules.values():
+                for ci in mi.top_classes.values():
+                    for mname, fi in ci.methods.items():
+                        table.setdefault(mname, []).append(fi)
+            self._methods_by_name = table  # type: ignore[attr-defined]
+        return list(table.get(name, ()))
+
+    def reachable_from(self, seeds: List[FuncInfo],
+                       duck: bool = True) -> Dict[int, FuncInfo]:
+        """BFS over the call graph from `seeds`: resolved calls, calls to
+        nested defs, and (with `duck`) name-based method fallback for
+        receivers the v2 resolution cannot type.  Returns
+        {id(FuncInfo): FuncInfo} of every function in the closure."""
+        seen: Dict[int, FuncInfo] = {}
+        work = list(seeds)
+        while work:
+            fi = work.pop()
+            if fi is None or id(fi) in seen or fi.node is None:
+                continue
+            seen[id(fi)] = fi
+            mi, owner = fi.module, fi.owner_class
+            if isinstance(fi.node, ast.Lambda):
+                nested: Dict[str, ast.AST] = {}
+            else:
+                nested = {n.name: n for n in cached_walk(fi.node)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                          and n is not fi.node}
+            for node in cached_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in nested:
+                    work.append(self._func_for_def(
+                        mi, nested[node.func.id]))
+                    continue
+                resolved = self.resolve_call_multi(mi, node.func, owner)
+                for callee, _off in resolved:
+                    work.append(callee)
+                if resolved or not duck \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr.startswith("__") \
+                        or node.func.attr in self._DUCK_SKIP:
+                    continue
+                base = node.func.value
+                # a module attribute (np.asarray) is not a duck method
+                if isinstance(base, ast.Name) and base.id in mi.imports:
+                    continue
+                # a self-attribute whose constructor is known and is NOT
+                # an in-package class is a stdlib instance (Thread, file,
+                # Queue): duck-stepping into package methods of the same
+                # name (`self._thread.start()` -> RunGuard.start) would
+                # be a false edge
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id in ("self", "cls") \
+                        and owner is not None:
+                    ctor = owner.find_attr_type(base.attr)
+                    if ctor is not None and not ctor.startswith(
+                            self.ctx.package_name + "."):
+                        head = ctor.split(".", 1)[0]
+                        if head not in mi.top_classes:
+                            continue
+                cands = self.methods_named(node.func.attr)
+                if 0 < len(cands) <= 4:
+                    work.extend(cands)
+        return seen
 
     # ---- call resolution ----------------------------------------------
 
@@ -605,11 +850,9 @@ class PackageIndex:
                     return tgt.top_funcs[attr]
         elif isinstance(func, ast.Attribute) and isinstance(func.value,
                                                             ast.Name):
-            imp = mi.imports.get(func.value.id)
-            if imp and imp[1] is None:
-                tgt = self.modules.get(imp[0])
-                if tgt and func.attr in tgt.top_funcs:
-                    return tgt.top_funcs[func.attr]
+            tgt = self._imported_module(mi, func.value.id)
+            if tgt and func.attr in tgt.top_funcs:
+                return tgt.top_funcs[func.attr]
         return None
 
     def resolve_call_multi(self, mi: ModuleInfo, func: ast.AST,
@@ -750,7 +993,7 @@ class Scope:
                     self.assigned.discard(name)
 
     def _bind(self, target: ast.AST) -> None:
-        for n in ast.walk(target):
+        for n in cached_walk(target):
             if isinstance(n, ast.Name):
                 self.assigned.add(n.id)
 
@@ -795,7 +1038,7 @@ class TaintWalker:
             root.tainted.add(name)
         # nested function name -> def node (first definition wins)
         self.nested: Dict[str, ast.AST] = {}
-        for node in ast.walk(fi.node):
+        for node in cached_walk(fi.node):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)) and node is not fi.node:
                 name = getattr(node, "name", None)
@@ -804,7 +1047,7 @@ class TaintWalker:
         # function-valued local bindings (tables built in this function)
         self.local_funcs: Dict[str, Set[int]] = {}
         if not isinstance(fi.node, ast.Lambda):
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if isinstance(node, ast.Assign):
                     refs = index.collect_refs(self.mi, node.value,
                                               self.owner_class, None)
@@ -817,6 +1060,16 @@ class TaintWalker:
                                     tt.id, set()).update(refs)
         # taints discovered for in-package callees: FuncInfo -> set(param)
         self.callee_taints: Dict[int, Tuple[FuncInfo, Set[str]]] = {}
+        # fixpoint-relevant statements, collected ONCE per walker: the
+        # env fixpoint used to re-walk the whole AST every iteration,
+        # which dominated the cold-lint profile
+        self._fix_nodes: List[Tuple[ast.AST, Scope]] = []
+        for scope in self.scopes:
+            for node in walk_scope(scope.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.NamedExpr,
+                                     ast.For, ast.withitem, ast.Call)):
+                    self._fix_nodes.append((node, scope))
 
     def _build_scopes(self, node: ast.AST, parent: Optional[Scope]) -> None:
         scope = Scope(node, parent)
@@ -904,7 +1157,7 @@ class TaintWalker:
         return sum(len(s.tainted) for s in self.scopes)
 
     def _bind_names(self, target: ast.AST, scope: Scope) -> None:
-        for node in ast.walk(target):
+        for node in cached_walk(target):
             if isinstance(node, ast.Name):
                 scope.add_taint(node.id)
 
@@ -1033,28 +1286,27 @@ class TaintWalker:
         self._param_funcs_changed = False
         for _ in range(max_iter):
             before = self._changed()
-            for scope in self.scopes:
-                for node in walk_scope(scope.node):
-                    if isinstance(node, ast.Assign):
-                        if self._taint(node.value, scope):
-                            for t in node.targets:
-                                self._bind_names(t, scope)
-                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-                        if node.value is not None \
-                                and self._taint(node.value, scope):
-                            self._bind_names(node.target, scope)
-                    elif isinstance(node, ast.NamedExpr):
-                        if self._taint(node.value, scope):
-                            self._bind_names(node.target, scope)
-                    elif isinstance(node, ast.For):
-                        if self._taint(node.iter, scope):
-                            self._bind_names(node.target, scope)
-                    elif isinstance(node, ast.withitem):
-                        if node.optional_vars is not None \
-                                and self._taint(node.context_expr, scope):
-                            self._bind_names(node.optional_vars, scope)
-                    elif isinstance(node, ast.Call):
-                        self._propagate_call(node, scope)
+            for node, scope in self._fix_nodes:
+                if isinstance(node, ast.Assign):
+                    if self._taint(node.value, scope):
+                        for t in node.targets:
+                            self._bind_names(t, scope)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    if node.value is not None \
+                            and self._taint(node.value, scope):
+                        self._bind_names(node.target, scope)
+                elif isinstance(node, ast.NamedExpr):
+                    if self._taint(node.value, scope):
+                        self._bind_names(node.target, scope)
+                elif isinstance(node, ast.For):
+                    if self._taint(node.iter, scope):
+                        self._bind_names(node.target, scope)
+                elif isinstance(node, ast.withitem):
+                    if node.optional_vars is not None \
+                            and self._taint(node.context_expr, scope):
+                        self._bind_names(node.optional_vars, scope)
+                elif isinstance(node, ast.Call):
+                    self._propagate_call(node, scope)
             if self._changed() == before:
                 break
 
@@ -1085,7 +1337,15 @@ def build_reachable(index: PackageIndex) -> List[FuncInfo]:
             if id(fi) in seen or fi.node is None:
                 continue
             seen.add(id(fi))
-            walker = TaintWalker(index, fi)
+            walker = getattr(fi, "_walker", None)
+            if walker is None:
+                walker = TaintWalker(index, fi)
+            else:
+                # reuse the walker across outer rounds (scope tree and
+                # statement lists are immutable); only the root taints
+                # grew since last round
+                root = walker.scope_of_def[id(fi.node)]
+                root.tainted |= fi.tainted_params
             walker.run_env_fixpoint()
             if walker._param_funcs_changed:
                 changed = True

@@ -294,11 +294,71 @@ def default_cache_path(package_dir: str) -> str:
                         ".tpulint_cache.json")
 
 
+# --------------------------------------------------------- parallel pass
+# Cold-run fan-out (ISSUE 9): the per-file rule passes are independent,
+# so they spread over a fork()-based process pool — each child inherits
+# the parsed LintContext (and the already-built call graph) copy-on-
+# write, runs every file-local rule for its files, and ships back plain
+# finding dicts.  The call-graph pass itself stays single-process by
+# design (its fixpoint is global), and the warm mtime-cache path is
+# untouched.  Engaged only when it can win: fork available, >1 CPU, and
+# enough uncached files to amortize the pool spin-up.
+
+_PARALLEL_STATE: Optional[Tuple] = None  # (ctx, set at fork time)
+
+
+def _file_local_child(args) -> List[Tuple[str, str, List[Dict]]]:
+    rel, rule_names = args
+    ctx = _PARALLEL_STATE
+    pf = next(p for p in ctx.files if p.rel == rel)
+    out = []
+    for name in rule_names:
+        fs = RULES[name].check_file(ctx, pf)
+        out.append((rel, name, [f.to_dict() for f in fs]))
+    return out
+
+
+def _run_file_local(ctx, pending: List[Tuple[str, List[str]]],
+                    jobs: Optional[int]
+                    ) -> List[Tuple[str, str, List[Dict]]]:
+    """(rel, rule, finding-dicts) for every pending (file, rules) unit,
+    serially or across a fork pool."""
+    import multiprocessing
+
+    eff = jobs if jobs is not None else (os.cpu_count() or 1)
+    eff = min(eff, len(pending))
+    use_pool = eff > 1 and len(pending) >= 8 \
+        and "fork" in multiprocessing.get_all_start_methods()
+    if use_pool:
+        global _PARALLEL_STATE
+        _PARALLEL_STATE = ctx
+        try:
+            with multiprocessing.get_context("fork").Pool(eff) as pool:
+                chunks = pool.map(_file_local_child, pending,
+                                  chunksize=max(1, len(pending) // eff))
+            return [item for chunk in chunks for item in chunk]
+        except Exception:
+            pass  # a pool problem must never fail the lint: fall through
+        finally:
+            _PARALLEL_STATE = None
+    by_rel = {pf.rel: pf for pf in ctx.files}
+    out = []
+    for rel, rule_names in pending:
+        pf = by_rel[rel]
+        for name in rule_names:
+            fs = RULES[name].check_file(ctx, pf)
+            out.append((rel, name, [f.to_dict() for f in fs]))
+    return out
+
+
 def run_lint(package_dir: str, rules: Optional[List[str]] = None,
              docs_dir: Optional[str] = None,
-             cache_path: Optional[str] = None) -> Report:
+             cache_path: Optional[str] = None,
+             jobs: Optional[int] = None) -> Report:
     """Run the (selected) rules over one package tree.  With
-    `cache_path`, reuse mtime-keyed results (see module comment)."""
+    `cache_path`, reuse mtime-keyed results (see module comment); with
+    `jobs` != 1, fan the per-file rule passes out across a process pool
+    (None = one worker per CPU)."""
     # rule modules self-register on import
     from . import rules as _rules  # noqa: F401
     ctx = LintContext(package_dir, docs_dir=docs_dir)
@@ -330,25 +390,36 @@ def run_lint(package_dir: str, rules: Optional[List[str]] = None,
     cached_files = (cache or {}).get("files", {})
     cached_per_file = (cache or {}).get("per_file", {})
     per_file: Dict[str, Dict[str, List[Dict]]] = {}
+    file_local = [n for n in selected if RULES[n].file_local]
+    # graph rules first: they build the shared index/reachable set the
+    # forked children then inherit copy-on-write
     for name in selected:
-        rule = RULES[name]
-        if not rule.file_local:
-            findings.extend(rule.check(ctx))
-            continue
-        for pf in ctx.files:
-            unchanged = (cached_files.get(pf.rel) == fkeys[pf.rel])
+        if not RULES[name].file_local:
+            findings.extend(RULES[name].check(ctx))
+    pending: List[Tuple[str, List[str]]] = []
+    for pf in ctx.files:
+        unchanged = (cached_files.get(pf.rel) == fkeys[pf.rel])
+        need: List[str] = []
+        for name in file_local:
             cached_l = (cached_per_file.get(pf.rel, {}).get(name)
                         if unchanged else None)
             if cached_l is not None:
                 fs = [Finding(**d) for d in cached_l]
                 for f in fs:
                     f.suppressed, f.justification = False, ""
+                per_file.setdefault(pf.rel, {})[name] = [
+                    dict(f.to_dict(), suppressed=False, justification="")
+                    for f in fs]
+                findings.extend(fs)
             else:
-                fs = rule.check_file(ctx, pf)
-            per_file.setdefault(pf.rel, {})[name] = [
-                dict(f.to_dict(), suppressed=False, justification="")
-                for f in fs]
-            findings.extend(fs)
+                need.append(name)
+        if need:
+            pending.append((pf.rel, need))
+    for rel, name, dicts in _run_file_local(ctx, pending, jobs):
+        fs = [Finding(**d) for d in dicts]
+        per_file.setdefault(rel, {})[name] = [
+            dict(d, suppressed=False, justification="") for d in dicts]
+        findings.extend(fs)
     findings = _apply_suppressions(ctx, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report = Report(findings=findings)
@@ -399,6 +470,51 @@ def apply_baseline(report: Report, path: str) -> Tuple[List[Finding], int]:
     return new, accepted
 
 
+# ---------------------------------------------------------------- SARIF
+def to_sarif(report: Report, failing: Optional[List[Finding]] = None
+             ) -> Dict:
+    """SARIF 2.1.0 for `--format=sarif`: the standard interchange format
+    PR annotation tooling (GitHub code scanning, reviewdog, IDEs)
+    ingests directly.  `failing` narrows the results to the
+    post-baseline NEW findings, mirroring the github format's
+    semantics; default is every active finding."""
+    results = report.active if failing is None else failing
+    rule_ids = sorted({f.rule for f in results} | set(RULES))
+    rules_meta = []
+    for rid in rule_ids:
+        entry = {"id": rid}
+        rule = RULES.get(rid)
+        if rule is not None:
+            entry["shortDescription"] = {"text": rule.description}
+        rules_meta.append(entry)
+    index_of = {rid: i for i, rid in enumerate(rule_ids)}
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "docs/StaticAnalysis.md",
+                "rules": rules_meta,
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "ruleIndex": index_of[f.rule],
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")},
+                        "region": {"startLine": max(f.line, 1),
+                                   "startColumn": f.col + 1},
+                    }}],
+            } for f in results],
+        }],
+    }
+
+
 # ----------------------------------------------------------- suppressions
 def iter_suppressions(package_dir: str):
     """Yield (rel_path, comment_line, rules, justification) for every
@@ -410,3 +526,24 @@ def iter_suppressions(package_dir: str):
             for sup in sups:
                 yield (pf.rel, sup.comment_line, sorted(sup.rules),
                        sup.justification)
+
+
+def audit_suppressions(package_dir: str,
+                       cache_path: Optional[str] = None):
+    """`iter_suppressions` plus a liveness verdict: the full rule suite
+    runs and each suppression is matched against the findings it
+    actually masked.  A suppression masking NOTHING is stale — its
+    finding was resolved (the way `wave.py:_psum` resolved when the v2
+    graph closed the shard_map distance) and keeping the comment would
+    silently swallow a future regression at that line.  Yields
+    (rel_path, comment_line, rules, justification, used)."""
+    report = run_lint(package_dir, cache_path=cache_path)
+    masked = {(f.path, f.line, f.rule) for f in report.suppressed}
+    ctx = LintContext(package_dir)
+    for pf in ctx.files:
+        for sups in pf.suppressions.values():
+            for sup in sups:
+                used = any((pf.rel, sup.line, r) in masked
+                           for r in sup.rules)
+                yield (pf.rel, sup.comment_line, sorted(sup.rules),
+                       sup.justification, used)

@@ -6,6 +6,7 @@ is always complete.  To add a rule, drop a module here and import it
 below (docs/StaticAnalysis.md "Adding a rule").
 """
 
+from . import atomic_write    # noqa: F401
 from . import bare_print      # noqa: F401
 from . import collectives     # noqa: F401
 from . import config_doc      # noqa: F401
@@ -15,5 +16,8 @@ from . import donate_sharding  # noqa: F401
 from . import donated_reuse   # noqa: F401
 from . import dtype           # noqa: F401
 from . import host_sync       # noqa: F401
+from . import rng_discipline  # noqa: F401
 from . import shape_taint     # noqa: F401
+from . import signal_safety   # noqa: F401
 from . import spmd            # noqa: F401
+from . import thread_safety   # noqa: F401

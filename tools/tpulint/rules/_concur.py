@@ -1,0 +1,130 @@
+"""Shared helpers for the v3 concurrency rules.
+
+`signal-handler-safety` and `thread-shared-state` both need to answer
+"what kind of synchronization object is this expression?" — a lock, a
+queue, an event, a thread.  Typing is resolved three ways, in order:
+
+1. **constructor-typed attributes**: `self._q = queue.Queue(...)` in a
+   class body or any method records `attr_types["_q"] = "queue.Queue"`
+   on the ClassInfo (callgraph v3), so `self._q.put(...)` resolves
+   exactly;
+2. **constructor-typed locals**: `q = queue.Queue()` inside the scanned
+   function;
+3. **name heuristics**: receivers whose name contains `lock`/`mutex`
+   (locks), `queue`/a bare `q` (queues), `event` (events) — the
+   fallback for objects typed in another module.  `all_tasks_done` /
+   `not_empty` / `not_full` / `mutex` are queue.Queue's internal
+   Condition/Lock attributes and count as locks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from ..callgraph import cached_walk
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "threading.Semaphore", "threading.BoundedSemaphore",
+              "multiprocessing.Lock", "multiprocessing.RLock",
+              "Lock", "RLock", "Condition", "Semaphore"}
+QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+               "queue.SimpleQueue", "multiprocessing.Queue", "Queue",
+               "LifoQueue", "PriorityQueue", "SimpleQueue"}
+EVENT_CTORS = {"threading.Event", "multiprocessing.Event", "Event"}
+THREAD_CTORS = {"threading.Thread", "Thread"}
+
+# queue.Queue internals: acquiring these IS acquiring a lock
+_LOCKISH_ATTRS = {"all_tasks_done", "not_empty", "not_full", "mutex"}
+
+
+def kind_of_ctor(dotted: Optional[str]) -> Optional[str]:
+    if dotted is None:
+        return None
+    if dotted in LOCK_CTORS:
+        return "lock"
+    if dotted in QUEUE_CTORS:
+        return "queue"
+    if dotted in EVENT_CTORS:
+        return "event"
+    if dotted in THREAD_CTORS:
+        return "thread"
+    return None
+
+
+def kind_of_name(name: str) -> Optional[str]:
+    low = name.lower().lstrip("_")
+    if name in _LOCKISH_ATTRS or "lock" in low or "mutex" in low \
+            or low in ("mu", "cv", "cond"):
+        return "lock"
+    if "queue" in low or low == "q":
+        return "queue"
+    if "event" in low:
+        return "event"
+    return None
+
+
+def local_ctor_types(mi, fn_node: ast.AST) -> Dict[str, str]:
+    """name -> kind for `q = queue.Queue()`-style locals of a function."""
+    out: Dict[str, str] = {}
+    for node in cached_walk(fn_node):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        kind = kind_of_ctor(mi.dotted_of(node.value.func))
+        if kind is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = kind
+    return out
+
+
+def receiver_kind(mi, owner_class, local_types: Dict[str, str],
+                  expr: ast.AST) -> Optional[str]:
+    """'lock' | 'queue' | 'event' | 'thread' | None for the receiver of
+    a method call (`<expr>.put(...)`) or a `with <expr>:` item."""
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and owner_class is not None:
+            t = kind_of_ctor(owner_class.find_attr_type(expr.attr))
+            if t is not None:
+                return t
+        return kind_of_name(expr.attr)
+    if isinstance(expr, ast.Name):
+        if expr.id in local_types:
+            return local_types[expr.id]
+        return kind_of_name(expr.id)
+    return None
+
+
+def lock_token(expr: ast.AST) -> Optional[str]:
+    """Stable identifier for a lock expression, so two `with self._mu:`
+    blocks compare equal in the lockset analysis."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def has_bound(call: ast.Call, kwargs=("timeout",),
+              flags=(("block", False), ("blocking", False))) -> bool:
+    """Does this call carry a bound — a `timeout=` keyword or a
+    non-blocking flag (`block=False` / `blocking=False`)?  A keyword
+    whose VALUE the analysis cannot prove is unbounded counts as bounded
+    (the caller thought about it); `timeout=None` literals do not."""
+    for kw in call.keywords:
+        if kw.arg in kwargs:
+            if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+                continue
+            return True
+        for name, val in flags:
+            if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value == val:
+                return True
+    return False

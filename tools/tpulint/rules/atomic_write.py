@@ -1,0 +1,120 @@
+"""atomic-write-discipline: reliability-critical files land whole or
+not at all.
+
+The PR-8 checkpoint manifest carries SHA-256 digests computed over "the
+exact bytes handed to the atomic writer"; resume integrity, generation
+fallback and the supervisor's stall/degrade state files all assume a
+reader can never observe a half-written file.  `utils.atomic_write_text
+/ atomic_write_bytes` (sibling temp file + `os.replace`) is the one
+sanctioned write path; a direct `open(path, "w")` under `reliability/`
+is a torn-file hazard that surfaces as a corrupt-checkpoint quarantine
+(at best) or a resume from damage (at worst).
+
+Flags `open(..., mode)` calls with a write-capable, non-append mode
+(`w`, `wb`, `w+`, `r+`, ...) in files under `reliability/`.  Append
+modes (`a`, `ab`) pass — the event log is append-only by design, and an
+interrupted append loses one record, not the file.  Reads pass.  An
+`open` inside a function that also calls `os.replace` or an
+`atomic_write_*` helper passes too: that IS the inline atomic idiom
+(tempfile + replace).  Deliberate in-place damage (fault injection's
+`ckpt_corrupt`) suppresses with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..callgraph import cached_walk, module_info_for
+from ..core import Finding, LintContext, Rule, register
+
+_SCOPE_PREFIXES = ("reliability",)
+_WRITE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b", "r+", "r+b", "rb+",
+                "x", "xb"}
+_ATOMIC_MARKERS = {"os.replace", "atomic_write_text",
+                   "atomic_write_bytes"}
+
+
+def _in_scope(pkg_rel: str) -> bool:
+    parts = pkg_rel.replace("\\", "/").split("/")
+    return parts[0] in _SCOPE_PREFIXES and len(parts) > 1
+
+
+def _open_mode(call: ast.Call) -> str:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return "r"
+
+
+@register
+class AtomicWriteDiscipline(Rule):
+    name = "atomic-write-discipline"
+    description = ("direct open(..., 'w') under reliability/ — "
+                   "checkpoint/manifest/state files must go through the "
+                   "temp+os.replace atomic writer the SHA-256 digests "
+                   "assume")
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
+        out: List[Finding] = []
+        if pf.tree is None or not _in_scope(pf.pkg_rel):
+            return out
+        mi = module_info_for(ctx, pf)
+        # functions whose body uses the inline atomic idiom are clean
+        atomic_fns = set()
+        for fn in cached_walk(pf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in cached_walk(fn):
+                if isinstance(node, ast.Call):
+                    dotted = mi.dotted_of(node.func) or ""
+                    if dotted in _ATOMIC_MARKERS \
+                            or dotted.rsplit(".", 1)[-1] in _ATOMIC_MARKERS:
+                        atomic_fns.add(id(fn))
+                        break
+
+        def enclosing_fn(target):
+            found = [None]
+
+            def rec(node, fn):
+                if node is target:
+                    found[0] = fn
+                    return True
+                for child in ast.iter_child_nodes(node):
+                    nfn = child if isinstance(
+                        child, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)) else fn
+                    if rec(child, nfn):
+                        return True
+                return False
+
+            rec(pf.tree, None)
+            return found[0]
+
+        for node in cached_walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                continue
+            mode = _open_mode(node).replace("t", "")
+            if mode not in _WRITE_MODES:
+                continue
+            fn = enclosing_fn(node)
+            if fn is not None and id(fn) in atomic_fns:
+                continue  # the inline temp+os.replace idiom
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=f"direct open(..., {mode!r}) under reliability/ "
+                        "— a crash mid-write leaves a torn file that "
+                        "the checkpoint digests will quarantine (or a "
+                        "reader resumes from damage); route through "
+                        "utils.atomic_write_text/bytes (temp + "
+                        "os.replace)"))
+        return out

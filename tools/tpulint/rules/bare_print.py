@@ -21,6 +21,7 @@ import ast
 import os
 from typing import List
 
+from ..callgraph import cached_walk
 from ..core import Finding, LintContext, Rule, register
 
 WHITELIST = {os.path.join("utils", "log.py")}
@@ -38,7 +39,7 @@ class NoBarePrint(Rule):
         out: List[Finding] = []
         if pf.tree is None or pf.pkg_rel in WHITELIST:
             return out
-        for node in ast.walk(pf.tree):
+        for node in cached_walk(pf.tree):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Name)
                     and node.func.id == "print"):

@@ -42,16 +42,16 @@ class NoDevicePutInLoop(Rule):
     file_local = True
 
     def check_file(self, ctx: LintContext, pf) -> List[Finding]:
-        from ..callgraph import ModuleInfo
+        from ..callgraph import cached_walk, module_info_for
         out: List[Finding] = []
         if pf.tree is None or not _in_scope(pf.pkg_rel):
             return out
-        mi = ModuleInfo(pf, ctx.package_name)
+        mi = module_info_for(ctx, pf)
         seen = set()
-        for loop in ast.walk(pf.tree):
+        for loop in cached_walk(pf.tree):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
-            for node in ast.walk(loop):
+            for node in cached_walk(loop):
                 if not isinstance(node, ast.Call):
                     continue
                 dotted = mi.dotted_of(node.func) or ""

@@ -29,6 +29,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional, Set, Tuple
 
+from ..callgraph import cached_walk, module_info_for
 from ..core import Finding, LintContext, Rule, register
 
 # canonical buffer parameter names the training loop uses
@@ -54,7 +55,7 @@ def _donate_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str],
         if kw.arg not in ("donate_argnums", "donate_argnames"):
             continue
         found = True
-        consts = [v for v in ast.walk(kw.value)
+        consts = [v for v in cached_walk(kw.value)
                   if isinstance(v, ast.Constant)]
         if isinstance(kw.value, (ast.Tuple, ast.List, ast.Constant)):
             for v in consts:
@@ -79,12 +80,11 @@ class DonateArgnums(Rule):
     file_local = True
 
     def check_file(self, ctx: LintContext, pf) -> List[Finding]:
-        from ..callgraph import ModuleInfo
         out: List[Finding] = []
         if pf.tree is None:
             return out
-        mi = ModuleInfo(pf, ctx.package_name)
-        for node in ast.walk(pf.tree):
+        mi = module_info_for(ctx, pf)
+        for node in cached_walk(pf.tree):
             if isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
@@ -126,7 +126,7 @@ class DonateArgnums(Rule):
         return None
 
     def _find_def(self, tree: ast.AST, name: str) -> Optional[ast.AST]:
-        for node in ast.walk(tree):
+        for node in cached_walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name == name:
                 return node

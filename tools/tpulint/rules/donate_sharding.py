@@ -26,6 +26,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
+from ..callgraph import cached_walk, module_info_for
 from ..core import Finding, LintContext, Rule, register
 from .spmd import _is_shard_map_call
 
@@ -40,24 +41,23 @@ class DonatedSharding(Rule):
     file_local = True
 
     def check_file(self, ctx: LintContext, pf) -> List[Finding]:
-        from ..callgraph import ModuleInfo
         out: List[Finding] = []
         if pf.tree is None:
             return out
-        self._check_module(ModuleInfo(pf, ctx.package_name), out)
+        self._check_module(module_info_for(ctx, pf), out)
         return out
 
     def _check_module(self, mi, out: List[Finding]) -> None:
         # names bound to a shard_map(...) result anywhere in the module
         # (module level or function-local)
         sm_names = set()
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if isinstance(node, ast.Assign) \
                     and _is_shard_map_call(mi, node.value):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         sm_names.add(t.id)
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             if mi.dotted_of(node.func) not in ("jax.jit", "jit"):

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import Finding, LintContext, Rule, register
+from ..callgraph import cached_walk
 from .host_sync import _analyze
 
 
@@ -65,7 +66,7 @@ class DonateSpec:
 def _const_ints_strs(expr: ast.AST) -> Tuple[Set[int], Set[str]]:
     idxs: Set[int] = set()
     names: Set[str] = set()
-    for v in ast.walk(expr):
+    for v in cached_walk(expr):
         if isinstance(v, ast.Constant):
             if isinstance(v.value, bool):
                 continue
@@ -94,7 +95,7 @@ class _DonatedIndex:
 
     # ---- collection ---------------------------------------------------
     def _scan_module(self, mi) -> None:
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     spec = self._spec_of_jit_call(mi, dec, node)
@@ -121,7 +122,7 @@ class _DonatedIndex:
 
     def _owning_class(self, mi, node: ast.AST) -> Optional[str]:
         for ci in mi.top_classes.values():
-            for n in ast.walk(ci.node):
+            for n in cached_walk(ci.node):
                 if n is node:
                     return ci.name
         return None
@@ -186,7 +187,7 @@ class _DonatedIndex:
 
     def _name_assignments(self, mi, name: str) -> List[ast.AST]:
         out = []
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Name) and t.id == name:
@@ -201,7 +202,7 @@ class _DonatedIndex:
         direct = self._spec_of_jit_call(mi, expr, None)
         if direct:
             return direct
-        for node in ast.walk(expr):
+        for node in cached_walk(expr):
             if isinstance(node, ast.Name):
                 s = self.resolve_name_spec(mi, node.id)
                 if s:
@@ -241,7 +242,7 @@ class _DonatedIndex:
                 if mi.pf.tree is None:
                     continue
                 for ci in mi.top_classes.values():
-                    for node in ast.walk(ci.node):
+                    for node in cached_walk(ci.node):
                         if not isinstance(node, ast.Assign):
                             continue
                         for t in node.targets:
@@ -443,7 +444,7 @@ class DonatedBufferReuse(Rule):
     # ---- helpers ------------------------------------------------------
     @staticmethod
     def _calls_in(stmt: ast.stmt):
-        for node in ast.walk(stmt):
+        for node in cached_walk(stmt):
             if isinstance(node, ast.Call):
                 yield node
 
@@ -465,7 +466,7 @@ class DonatedBufferReuse(Rule):
         if not state.consumed:
             return
         pf = mi.pf
-        for n in ast.walk(node):
+        for n in cached_walk(node):
             key = None
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
                 key = n.id
@@ -488,7 +489,7 @@ class DonatedBufferReuse(Rule):
             state.rebind(key)
 
     def _apply_targets(self, target: ast.AST, state: _State) -> None:
-        for n in ast.walk(target):
+        for n in cached_walk(target):
             if isinstance(n, (ast.Name, ast.Attribute)):
                 key = _binding_key(n)
                 if key and isinstance(getattr(n, "ctx", None),

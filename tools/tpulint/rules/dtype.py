@@ -44,12 +44,12 @@ class ExplicitDtype(Rule):
     file_local = True
 
     def check_file(self, ctx: LintContext, pf) -> List[Finding]:
-        from ..callgraph import ModuleInfo
+        from ..callgraph import cached_walk, module_info_for
         out: List[Finding] = []
         if pf.tree is None or not _in_scope(pf.pkg_rel):
             return out
-        mi = ModuleInfo(pf, ctx.package_name)
-        for node in ast.walk(pf.tree):
+        mi = module_info_for(ctx, pf)
+        for node in cached_walk(pf.tree):
             if not isinstance(node, ast.Call):
                 continue
             dotted = mi.dotted_of(node.func) or ""

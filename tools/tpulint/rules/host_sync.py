@@ -27,7 +27,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..callgraph import PackageIndex, build_reachable
+from ..callgraph import cached_walk, PackageIndex, build_reachable
 from ..core import Finding, LintContext, Rule, register
 
 SYNC_BUILTINS = {"float", "int", "bool", "complex"}
@@ -71,7 +71,7 @@ class NoHostSyncInJit(Rule):
 
         def visit(fi, walker):
             pf = fi.module.pf
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if not isinstance(node, ast.Call):
                     continue
                 msg = None
@@ -120,7 +120,7 @@ class NoTracerBranch(Rule):
 
         def visit(fi, walker):
             pf = fi.module.pf
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 kind = None
                 test = None
                 if isinstance(node, ast.If):

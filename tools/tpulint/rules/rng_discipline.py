@@ -1,0 +1,242 @@
+"""rng-stream-discipline: the static half of the byte-exact-resume
+contract.
+
+PR 1/PR 8 byte-exactness rests on one invariant: every random draw is a
+pure function of (seed, absolute iteration, site) — `PRNGKey(seed +
+abs_iter)`, `fold_in(key, tag)`, per-instance `RandomState`s carried in
+the checkpoint.  Three statically-checkable ways to break it:
+
+* **key reuse** — the same key VALUE consumed by two sampling ops
+  (`normal(key, ...)` then `uniform(key, ...)`) yields correlated
+  draws; jax keys are consumed exactly once, with `split`/`fold_in` as
+  the only sanctioned derivations.  Tracked per function in statement
+  order: consuming ops are the `jax.random` samplers AND `split`
+  (splitting an already-consumed key is reuse too); `fold_in` derives
+  without consuming (the package's tag-stream idiom); rebinding the
+  name resets it.  A consumer inside a loop whose key is never rebound
+  in that loop repeats the stream every iteration and is flagged on the
+  same logic (the loop body is analyzed twice).
+
+* **module-level numpy state** — `np.random.seed/rand/shuffle/...`
+  mutate one hidden process-global stream: any other consumer (another
+  subsystem, a retry, a different rank count) shifts every draw after
+  it, and resume cannot reproduce it.  Instance RNGs
+  (`np.random.RandomState(seed)`, `default_rng`) are the clean form and
+  pass.
+
+* **loop-invariant stream construction** — `PRNGKey(seed)` /
+  `RandomState(seed)` built INSIDE a loop from arguments that never
+  change across iterations re-seeds the identical stream every pass;
+  the construction must be keyed by the loop variable or an absolute
+  iteration (`PRNGKey(seed + abs_iter)` — the gbdt.py bagging idiom).
+
+File-local by design (no call graph): key values that cross function
+boundaries are not tracked — the fixtures pin the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..callgraph import cached_walk
+from ..core import Finding, LintContext, Rule, register
+
+# jax.random ops that CONSUME their key argument (first positional or
+# key=).  split consumes; fold_in derives a child stream and is the
+# sanctioned way to reuse a parent key across tags.
+_CONSUMERS = {
+    "uniform", "normal", "bernoulli", "randint", "choice", "permutation",
+    "shuffle", "gumbel", "exponential", "gamma", "beta", "poisson",
+    "truncated_normal", "categorical", "laplace", "logistic",
+    "rademacher", "bits", "ball", "dirichlet", "multivariate_normal",
+    "orthogonal", "t", "cauchy", "double_sided_maxwell", "maxwell",
+    "pareto", "rayleigh", "weibull_min", "loggamma", "binomial",
+    "split",
+}
+_NP_INSTANCE_OK = {"RandomState", "default_rng", "Generator",
+                   "SeedSequence", "BitGenerator", "PCG64", "Philox"}
+_STREAM_CTORS = {"PRNGKey", "key", "RandomState", "default_rng"}
+
+
+def _dotted_tail(mi, call: ast.Call):
+    dotted = mi.dotted_of(call.func) or ""
+    mod, _, tail = dotted.rpartition(".")
+    return dotted, mod, tail
+
+
+def _key_name(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value.id
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _assigned_names(stmts: List[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for s in stmts:
+        for n in cached_walk(s):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+    return out
+
+
+@register
+class RngStreamDiscipline(Rule):
+    name = "rng-stream-discipline"
+    description = ("PRNG key reuse without split/fold_in, np.random "
+                   "module-level state, or loop-invariant stream "
+                   "construction — the byte-exact-resume RNG contract")
+    file_local = True
+
+    def check_file(self, ctx: LintContext, pf) -> List[Finding]:
+        out: List[Finding] = []
+        if pf.tree is None:
+            return out
+        from ..callgraph import module_info_for
+        mi = module_info_for(ctx, pf)
+        self._np_module_state(mi, pf, out)
+        # one statement-ordered pass per function scope (module level too)
+        scopes = [pf.tree] + [
+            n for n in cached_walk(pf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            body = scope.body if not isinstance(scope, ast.Module) \
+                else scope.body
+            self._walk_block(mi, pf, body, set(), out, set(),
+                             own_scope=scope)
+        return out
+
+    # ---- np.random module-level state ---------------------------------
+    def _np_module_state(self, mi, pf, out: List[Finding]) -> None:
+        for node in cached_walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted, mod, tail = _dotted_tail(mi, node)
+            if mod in ("numpy.random", "np.random") \
+                    and tail not in _NP_INSTANCE_OK:
+                out.append(Finding(
+                    rule=self.name, path=pf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"np.random.{tail} uses the process-global "
+                            "numpy stream: any other consumer (retry, "
+                            "resume, rank-count change) shifts every "
+                            "later draw — use an instance "
+                            "RandomState/default_rng keyed by seed and "
+                            "absolute iteration"))
+
+    # ---- key-reuse + loop-invariant construction ----------------------
+    def _walk_block(self, mi, pf, stmts: List[ast.AST],
+                    consumed: Set[str], out: List[Finding],
+                    reported: Set[int], own_scope=None,
+                    loop_bound: Optional[Set[str]] = None) -> Set[str]:
+        """Statement-ordered scan of one block; returns the consumed set
+        at block exit.  `loop_bound`: names rebound per iteration of the
+        innermost enclosing loop (None outside loops)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(stmt, (ast.For, ast.While)):
+                bound = _assigned_names([stmt])
+                if isinstance(stmt, ast.For):
+                    bound |= _assigned_names([stmt.target])
+                self._loop_invariant_ctors(mi, pf, stmt, bound, out,
+                                           reported)
+                # analyze the body twice: the second pass sees the
+                # first iteration's consumptions, catching a key
+                # consumed on every pass without a per-iteration rebind
+                inner = set(consumed)
+                inner = self._walk_block(mi, pf, stmt.body, inner, out,
+                                         set(), loop_bound=bound)
+                self._walk_block(mi, pf, stmt.body, inner, out,
+                                 reported, loop_bound=bound)
+                consumed |= inner
+                self._walk_block(mi, pf, stmt.orelse, consumed, out,
+                                 reported, loop_bound=loop_bound)
+                continue
+            if isinstance(stmt, ast.If):
+                a = self._walk_block(mi, pf, stmt.body, set(consumed),
+                                     out, reported, loop_bound=loop_bound)
+                b = self._walk_block(mi, pf, stmt.orelse, set(consumed),
+                                     out, reported, loop_bound=loop_bound)
+                consumed.clear()
+                consumed |= a | b  # conservative merge
+                continue
+            if isinstance(stmt, (ast.With, ast.Try)):
+                blocks = [getattr(stmt, "body", [])]
+                for h in getattr(stmt, "handlers", []):
+                    blocks.append(h.body)
+                blocks.append(getattr(stmt, "orelse", []))
+                blocks.append(getattr(stmt, "finalbody", []))
+                for b in blocks:
+                    consumed = self._walk_block(
+                        mi, pf, b, consumed, out, reported,
+                        loop_bound=loop_bound)
+                continue
+            # plain statement: consumptions first, then rebinds
+            for node in cached_walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted, mod, tail = _dotted_tail(mi, node)
+                if tail not in _CONSUMERS or not mod.endswith("random"):
+                    continue
+                key = _key_name(node)
+                if key is None:
+                    continue
+                if key in consumed and id(node) not in reported:
+                    reported.add(id(node))
+                    out.append(Finding(
+                        rule=self.name, path=pf.rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"PRNG key `{key}` is consumed again by "
+                                f"jax.random.{tail} without an "
+                                "intervening split/fold_in rebind — "
+                                "reused keys repeat the same draws"
+                                + (" on every loop iteration"
+                                   if loop_bound is not None
+                                   and key not in loop_bound else "")
+                                + ", breaking the draw-once stream "
+                                "discipline byte-exact resume depends "
+                                "on"))
+                consumed.add(key)
+            for n in cached_walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    consumed.discard(n.id)
+        return consumed
+
+    def _loop_invariant_ctors(self, mi, pf, loop, bound: Set[str],
+                              out: List[Finding],
+                              reported: Set[int]) -> None:
+        """`PRNGKey(seed)` / `RandomState(seed)` inside a loop with no
+        argument depending on a name the loop rebinds."""
+        for node in cached_walk(loop):
+            if not isinstance(node, ast.Call) or id(node) in reported:
+                continue
+            dotted, mod, tail = _dotted_tail(mi, node)
+            if tail not in _STREAM_CTORS:
+                continue
+            if not (mod.endswith("random") or mod in ("jax.random",)):
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if not args:
+                continue
+            names = {n.id for a in args for n in cached_walk(a)
+                     if isinstance(n, ast.Name)}
+            if names & bound:
+                continue
+            reported.add(id(node))
+            out.append(Finding(
+                rule=self.name, path=pf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=f"{tail}(...) constructed inside a loop from "
+                        "loop-invariant arguments: every iteration "
+                        "re-seeds the identical stream — key the seed "
+                        "by the loop/absolute iteration "
+                        "(`PRNGKey(seed + abs_iter)`, the bagging "
+                        "idiom) or hoist the construction out"))

@@ -42,6 +42,7 @@ import ast
 from typing import List, Optional, Set
 
 from ..core import Finding, LintContext, Rule, register
+from ..callgraph import cached_walk
 from .host_sync import _for_each_function
 
 _NP_MODULES = ("jax.numpy", "jnp", "numpy", "np")
@@ -90,7 +91,7 @@ class _BoolNames:
         self.keys: Set[tuple] = set()
         for _ in range(4):
             before = len(self.keys)
-            for node in ast.walk(walker.fi.node):
+            for node in cached_walk(walker.fi.node):
                 if isinstance(node, ast.Assign) \
                         and self.is_bool_expr(node.value):
                     scope = walker.node_scope.get(id(node))
@@ -160,7 +161,7 @@ class NoDynamicShapeInJit(Rule):
             pf = fi.module.pf
             mi = fi.module
             bools = _BoolNames(mi, walker)
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if isinstance(node, ast.Call):
                     self._check_call(pf, mi, node, fi, walker, flag)
                 elif isinstance(node, ast.Subscript):

@@ -37,6 +37,7 @@ import ast
 from typing import List, Optional, Set
 
 from ..core import Finding, LintContext, Rule, register
+from ..callgraph import cached_walk
 from .host_sync import _analyze
 
 COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
@@ -99,7 +100,7 @@ class SpmdAxisDiscipline(Rule):
         for mi in index.modules.values():
             if mi.pf.tree is None:
                 continue
-            for node in ast.walk(mi.pf.tree):
+            for node in cached_walk(mi.pf.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 dotted = (mi.dotted_of(node.func) or "").rsplit(".", 1)[-1]
@@ -142,11 +143,11 @@ class SpmdAxisDiscipline(Rule):
             for fi in funcs:
                 if isinstance(fi.node, ast.Lambda):
                     continue
-                nested = {n.name: n for n in ast.walk(fi.node)
+                nested = {n.name: n for n in cached_walk(fi.node)
                           if isinstance(n, (ast.FunctionDef,
                                             ast.AsyncFunctionDef))
                           and n is not fi.node}
-                for node in ast.walk(fi.node):
+                for node in cached_walk(fi.node):
                     if _is_shard_map_call(mi, node):
                         target = node.args[0] if node.args else None
                         for kw in node.keywords:
@@ -155,7 +156,7 @@ class SpmdAxisDiscipline(Rule):
                         if target is not None:
                             note_ref(mi, fi.owner_class, nested, target)
             # module-level shard_map calls
-            for node in ast.walk(mi.pf.tree):
+            for node in cached_walk(mi.pf.tree):
                 if _is_shard_map_call(mi, node) and node.args:
                     note_ref(mi, None, {}, node.args[0])
 
@@ -168,7 +169,7 @@ class SpmdAxisDiscipline(Rule):
                 continue
             seen.add(id(fi))
             rooted_defs.add(id(fi.node))
-            for node in ast.walk(fi.node):
+            for node in cached_walk(fi.node):
                 if isinstance(node, ast.Call):
                     for callee, _off in index.resolve_call_multi(
                             fi.module, node.func, fi.owner_class):
@@ -198,7 +199,7 @@ class SpmdAxisDiscipline(Rule):
             return found
 
         exempt = mi.pf.pkg_rel in _EXEMPT_FILES
-        for node in ast.walk(mi.pf.tree):
+        for node in cached_walk(mi.pf.tree):
             if not isinstance(node, ast.Call):
                 continue
             dotted = mi.dotted_of(node.func) or ""

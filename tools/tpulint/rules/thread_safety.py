@@ -1,0 +1,330 @@
+"""thread-shared-state: a lockset-style race detector for the host-side
+concurrency surface.
+
+PRs 7–8 grew real threads — the RunGuard watchdog, the AsyncWriter
+worker, submit()-deferred checkpoint writes — and the bug class that
+produces the next silent failure is an attribute mutated on one thread
+while another reads it with no synchronization (RunGuard's tick state
+vs. the watchdog, the checkpoint generations list vs. save_now).  None
+of that is visible to a single-threaded test.
+
+Model (docs/StaticAnalysis.md "The lockset model"):
+
+* every function is assigned to one or more CONCURRENT ROOT SETS —
+  *thread* (reachable from a `threading.Thread(target=...)` entry or a
+  `.submit(...)`-deferred callable), *handler* (reachable from a signal
+  handler, duck-typed reach), and *main* (reachable from everything
+  else);
+* accesses to `self.<attr>` inside a class's methods are collected with
+  the set of locks lexically held (`with self._lock:` /
+  `with lock:` blocks; lock-ness per `_concur` typing);
+* an attribute WRITTEN outside `__init__` in one root set and accessed
+  in a different root set with an empty lockset intersection is a
+  finding, reported at the unlocked site.  A function that belongs to
+  both the *thread* set and another set races WITH ITSELF, so two
+  distinct access sites inside the thread-shared function pair conflict
+  too.
+* module GLOBALS rebound under a `global` declaration get the same
+  treatment across the functions of their module.
+
+Happens-before exemptions: writes in `__init__`, and writes in the
+method that CONSTRUCTS the thread when the conflicting access is on the
+constructed thread's side (`Thread.start()` publishes everything
+sequenced before it).
+
+Known approximations (pinned by the fixtures): mutating METHOD calls
+(`self.knobs.update(...)`, `deque.append`) are not writes — CPython
+makes single bytecode container ops atomic, and counting them floods
+the rule; locks held by a CALLER are invisible at the callee's accesses
+(hold the lock lexically around the access, or restructure); closure
+dicts shared with a handler (`_progress` in engine.train) are untyped
+and unseen.  The same-thread `handler` set never conflicts with itself
+within one function (reentrancy, not a data race).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..callgraph import cached_walk
+from ..core import Finding, LintContext, Rule, register
+from ._concur import kind_of_ctor, local_ctor_types, lock_token, \
+    receiver_kind
+from .host_sync import _analyze
+from .signal_safety import concurrency_reaches
+
+# sync primitives are internally consistent; rebinding a Thread attr is
+# still interesting (the flush-reads-_thread shape), so 'thread' stays
+_EXEMPT_ATTR_KINDS = {"lock", "queue", "event"}
+
+
+@dataclass
+class _Access:
+    attr: str
+    is_write: bool
+    func: object                 # FuncInfo
+    node: ast.AST
+    locks: FrozenSet[str]
+    sides: FrozenSet[str] = frozenset()
+    in_init: bool = False
+    prestart: bool = False       # write in the thread-creating method
+
+
+def _is_thread_ctor(mi, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = mi.dotted_of(node.func) or ""
+    return dotted.rsplit(".", 1)[-1] == "Thread" \
+        and dotted.startswith(("threading.", "Thread"))
+
+
+class _AccessCollector:
+    """Lexically-scoped walk of one function body collecting self.<attr>
+    reads/writes and `global` rebinds, with the held lockset."""
+
+    def __init__(self, mi, owner, fi):
+        self.mi = mi
+        self.owner = owner
+        self.fi = fi
+        self.locals_ = local_ctor_types(mi, fi.node)
+        self.attr_accesses: List[_Access] = []
+        self.global_writes: Dict[str, List[_Access]] = {}
+        self.global_reads: Dict[str, List[_Access]] = {}
+        self.global_names: Set[str] = set()
+        self._claimed: Set[int] = set()
+        for n in cached_walk(fi.node):
+            if isinstance(n, ast.Global):
+                self.global_names.update(n.names)
+        self._visit(fi.node, frozenset(), in_nested=False)
+
+    # ---- helpers ------------------------------------------------------
+    def _self_attr(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls"):
+            return expr.attr
+        return None
+
+    def _write_target_attrs(self, t: ast.AST) -> List[Tuple[str, ast.AST]]:
+        out = []
+        for n in cached_walk(t):
+            if isinstance(n, ast.Subscript):
+                attr = self._self_attr(n.value)
+                if attr is not None:
+                    out.append((attr, n.value))
+                    self._claimed.add(id(n.value))
+            else:
+                attr = self._self_attr(n)
+                if attr is not None and isinstance(
+                        getattr(n, "ctx", None), ast.Store):
+                    out.append((attr, n))
+        return out
+
+    def _record_attr(self, attr, node, is_write, locks):
+        self.attr_accesses.append(_Access(
+            attr=attr, is_write=is_write, func=self.fi, node=node,
+            locks=locks))
+
+    def _record_global(self, name, node, is_write, locks):
+        table = self.global_writes if is_write else self.global_reads
+        table.setdefault(name, []).append(_Access(
+            attr=name, is_write=is_write, func=self.fi, node=node,
+            locks=locks))
+
+    # ---- walk ---------------------------------------------------------
+    def _visit(self, node: ast.AST, locks: FrozenSet[str],
+               in_nested: bool) -> None:
+        if isinstance(node, ast.With):
+            held = set(locks)
+            for item in node.items:
+                if receiver_kind(self.mi, self.owner, self.locals_,
+                                 item.context_expr) == "lock":
+                    tok = lock_token(item.context_expr)
+                    if tok:
+                        held.add(tok)
+            for child in node.body:
+                self._visit(child, frozenset(held), in_nested)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.fi.node:
+            # a nested def runs later: the enclosing lockset is NOT held
+            body = node.body if not isinstance(node, ast.Lambda) \
+                else [node.body]
+            for child in body:
+                self._visit(child, frozenset(), True)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for attr, tn in self._write_target_attrs(t):
+                    self._record_attr(attr, tn, True, locks)
+                for n in cached_walk(t):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, ast.Store) \
+                            and n.id in self.global_names:
+                        self._record_global(n.id, n, True, locks)
+            if node.value is not None:
+                self._visit(node.value, locks, in_nested)
+            return
+        attr = self._self_attr(node)
+        if attr is not None and id(node) not in self._claimed \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            self._record_attr(attr, node, False, locks)
+        if isinstance(node, ast.Name) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            self._record_global(node.id, node, False, locks)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, locks, in_nested)
+
+
+@register
+class ThreadSharedState(Rule):
+    name = "thread-shared-state"
+    description = ("attribute/global written on one concurrent root "
+                   "(thread / signal handler / main) and accessed on "
+                   "another with no common lock")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        index, handler_reach, _exit_reach, thread_reach = \
+            concurrency_reaches(ctx)
+        # main set: closure of everything that is not already on a
+        # concurrent root — a function can be in several sets
+        main_seeds = [fi for fi in index._named_funcs()
+                      if id(fi) not in thread_reach
+                      and id(fi) not in handler_reach]
+        main_reach = index.reachable_from(main_seeds, duck=False)
+
+        def sides(fi) -> FrozenSet[str]:
+            s = set()
+            if id(fi) in thread_reach:
+                s.add("thread")
+            if id(fi) in handler_reach:
+                s.add("handler")
+            if id(fi) in main_reach:
+                s.add("main")
+            return frozenset(s or {"main"})
+
+        out: List[Finding] = []
+        for mi in index.modules.values():
+            if mi.pf.tree is None:
+                continue
+            self._check_classes(index, mi, sides, out)
+            self._check_globals(index, mi, sides, out)
+        return out
+
+    # ---- classes ------------------------------------------------------
+    def _check_classes(self, index, mi, sides, out) -> None:
+        for ci in mi.top_classes.values():
+            methods = list(ci.methods.values())
+            if not any("thread" in sides(m) or "handler" in sides(m)
+                       for m in methods):
+                continue  # no concurrency touches this class
+            accesses: List[_Access] = []
+            for m in methods:
+                if m.node is None:
+                    continue
+                coll = _AccessCollector(mi, ci, m)
+                s = sides(m)
+                init = m.qualname.endswith("__init__")
+                pre = any(_is_thread_ctor(mi, n)
+                          for n in cached_walk(m.node))
+                for a in coll.attr_accesses:
+                    a.sides, a.in_init, a.prestart = s, init, pre
+                    accesses.append(a)
+            self._conflicts(ci.name, accesses, out,
+                            attr_kind=lambda attr: kind_of_ctor(
+                                ci.find_attr_type(attr)))
+
+    # ---- globals ------------------------------------------------------
+    def _check_globals(self, index, mi, sides, out) -> None:
+        # cheap gate: a module with no `global` statement has no
+        # function-scope global rebinds to analyze
+        if not any(isinstance(n, ast.Global)
+                   for n in cached_walk(mi.pf.tree)):
+            return
+        funcs = list(mi.top_funcs.values())
+        for ci in mi.top_classes.values():
+            funcs += list(ci.methods.values())
+        writes: List[_Access] = []
+        reads: Dict[str, List[_Access]] = {}
+        written_names: Set[str] = set()
+        colls = []
+        for fi in funcs:
+            if fi.node is None or isinstance(fi.node, ast.Lambda):
+                continue
+            coll = _AccessCollector(mi, fi.owner_class, fi)
+            colls.append((fi, coll))
+            for name, accs in coll.global_writes.items():
+                written_names.add(name)
+                for a in accs:
+                    a.sides = sides(fi)
+                    writes.append(a)
+        if not written_names:
+            return
+        for fi, coll in colls:
+            for name in written_names:
+                for a in coll.global_reads.get(name, []):
+                    a.sides = sides(fi)
+                    reads.setdefault(name, []).append(a)
+        accesses = writes + [a for accs in reads.values() for a in accs]
+        self._conflicts(mi.dotted.rsplit(".", 1)[-1], accesses, out,
+                        attr_kind=lambda attr: None, kind_word="global")
+
+    # ---- conflict detection -------------------------------------------
+    def _conflicts(self, scope_name: str, accesses: List[_Access], out,
+                   attr_kind, kind_word: str = "attribute") -> None:
+        by_attr: Dict[str, List[_Access]] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(by_attr.items()):
+            if attr_kind(attr) in _EXEMPT_ATTR_KINDS:
+                continue
+            writes = [a for a in accs if a.is_write and not a.in_init]
+            if not writes:
+                continue
+            found = None
+            for w in writes:
+                for a in accs:
+                    if a is w or a.in_init:
+                        continue
+                    union = w.sides | a.sides
+                    if len(union) < 2:
+                        continue
+                    if a.func is w.func:
+                        # one function racing with itself needs real
+                        # parallelism (a thread side), not reentrancy
+                        common = w.sides & a.sides
+                        if "thread" not in common or len(common) < 2:
+                            continue
+                    # Thread.start() publishes writes sequenced before
+                    # it: the creator method's writes are safe against
+                    # the created thread's side
+                    if w.prestart and a.sides == frozenset({"thread"}):
+                        continue
+                    if w.locks & a.locks:
+                        continue
+                    found = (w, a)
+                    break
+                if found:
+                    break
+            if not found:
+                continue
+            w, a = found
+            site = w if not w.locks else a
+            other = a if site is w else w
+            out.append(Finding(
+                rule=self.name, path=site.func.module.pf.rel,
+                line=site.node.lineno, col=site.node.col_offset,
+                message=f"{kind_word} `{attr}` of `{scope_name}` is "
+                        f"{'written' if site.is_write else 'read'} in "
+                        f"`{site.func.qualname}` "
+                        f"({'/'.join(sorted(site.sides))} side) with no "
+                        f"lock while `{other.func.qualname}` "
+                        f"({'/'.join(sorted(other.sides))} side) "
+                        f"{'writes' if other.is_write else 'reads'} it"
+                        " — hold one common lock at both sites or "
+                        "confine the state to one thread "
+                        "(docs/StaticAnalysis.md lockset model)"))

@@ -30,14 +30,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
 
 if [[ "${1:-}" != "--quick" ]]; then
     step "tier-1 (full suite, 870 s cap)"
-    rm -f /tmp/_t1.log
+    rm -f /tmp/_t1.log /tmp/_t1.xml
+    # pass count comes from --junitxml, not the dot stream: one pytest
+    # process writes one report file, so an orphaned/background pytest
+    # interleaving ITS dots into the captured log can no longer skew
+    # DOTS_PASSED (tools/junit_passed.py falls back to the dot grep
+    # only when the timeout killed pytest before the XML was written)
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly \
+        --junitxml=/tmp/_t1.xml -o junit_family=xunit2 \
         2>&1 | tee /tmp/_t1.log
     rc=${PIPESTATUS[0]}
-    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
-        | tr -cd . | wc -c)"
+    echo "DOTS_PASSED=$(python tools/junit_passed.py /tmp/_t1.xml /tmp/_t1.log)"
     [[ $rc -ne 0 ]] && fail=1
 fi
 
