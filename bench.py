@@ -232,6 +232,178 @@ def _predict_throughput(booster, X):
     return out
 
 
+def serve_main(smoke: bool = False) -> int:
+    """Closed-loop serving bench (ISSUE 10): `python bench.py --serve`.
+
+    Drives the serving daemon with S concurrent closed-loop streams
+    (one outstanding request per stream, resubmitted on completion),
+    hot-swaps a second model mid-run, and prints ONE JSON line with
+    `serve_p50_ms` / `serve_p99_ms` / `serve_rows_per_s` /
+    `serve_recompiles`.  Every response is checked BYTE-IDENTICAL
+    against `Booster.predict` of the model version that served it —
+    a swap may answer with either version, never a mix, never a drop.
+
+    Streams are multiplexed over a small thread pool (S streams / T
+    threads, each thread submits its streams' requests then waits them
+    all — one outstanding request per stream, closed-loop): the CPU
+    container has a single core, so S OS threads would bench the GIL,
+    not the daemon.  `--smoke` shrinks everything for the verify gate.
+    """
+    backend_fallback = _ensure_jax_backend()
+    import jax
+    if backend_fallback:
+        jax.config.update("jax_platforms", "cpu")
+    _backend_guard()
+
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import ServingDaemon
+
+    streams = int(os.environ.get("BENCH_SERVE_STREAMS",
+                                 64 if smoke else 1024))
+    rounds = int(os.environ.get("BENCH_SERVE_ROUNDS", 3 if smoke else 10))
+    req_rows = int(os.environ.get("BENCH_SERVE_REQ_ROWS", 4))
+    n_threads = max(1, min(16, streams))
+    per_thread = max(1, streams // n_threads)
+    streams = n_threads * per_thread
+
+    # model pair: v2 continues v1 so the swap changes every score
+    Xtr, ytr = make_higgs_like(20_000, FEATURES, seed=7)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20, "device_predict": "true",
+              "device_predict_min_bucket": 128}
+    b1 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=20)
+    b2 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=40)
+
+    pool, _ = make_higgs_like(4096, FEATURES, seed=8)
+    pool = np.ascontiguousarray(pool, np.float32)
+    # expected scores per version VIA Booster.predict (the acceptance
+    # oracle); responses must match the serving version byte-for-byte
+    expected = {1: b1.predict(pool), 2: b2.predict(pool)}
+
+    cfg = Config({**params,
+                  "serve_max_batch_rows": 4096,
+                  "serve_queue_depth": max(streams * 2, 64),
+                  "serve_max_coalesce_wait_ms": float(
+                      os.environ.get("BENCH_SERVE_WAIT_MS", 2.0))})
+    daemon = ServingDaemon(cfg).start()
+    v1_handle = daemon.registry.register("higgs", booster=b1, block=True)
+    warmup_recompiles = daemon.registry.serve_recompiles()
+
+    latencies: list = []
+    failures: list = []
+    lat_lock = threading.Lock()
+    rows_served = [0]
+    versions_seen: set = set()
+    swap_gate = threading.Event()
+    start_gate = threading.Barrier(n_threads + 1)
+
+    def slice_for(stream: int, rnd: int):
+        start = ((stream * 2654435761 + rnd * 97) % (len(pool) - req_rows))
+        return start, pool[start:start + req_rows]
+
+    def client(tid: int) -> None:
+        start_gate.wait()
+        my_streams = range(tid * per_thread, (tid + 1) * per_thread)
+        for rnd in range(rounds):
+            futs = []
+            for s in my_streams:
+                start, rows = slice_for(s, rnd)
+                try:
+                    futs.append((start, daemon.submit("higgs", rows)))
+                except Exception as e:  # noqa: BLE001
+                    with lat_lock:
+                        failures.append(f"submit:{e}")
+            for start, fut in futs:
+                try:
+                    out = fut.result(timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    with lat_lock:
+                        failures.append(f"result:{e}")
+                    continue
+                exp = expected[fut.version][start:start + req_rows]
+                ok = np.array_equal(out, exp)
+                with lat_lock:
+                    latencies.append(fut.latency_ms)
+                    rows_served[0] += req_rows
+                    versions_seen.add(fut.version)
+                    if not ok:
+                        failures.append(
+                            f"mismatch v{fut.version}@{start}")
+            if tid == 0 and rnd == max(rounds // 2 - 1, 0):
+                swap_gate.set()  # main hot-swaps while rounds continue
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    t0 = time.time()
+    start_gate.wait()
+    swap_gate.wait(timeout=300)
+    # hot swap MID-LOAD: the v2 load+warmup runs on a background thread
+    # while v1 keeps serving; in-flight requests finish on v1
+    swap_handle = daemon.registry.register("higgs", booster=b2, block=False)
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.time() - t0
+    swap_handle.wait(timeout=120)
+
+    # post-swap phase: the background v2 warmup typically outlasts the
+    # closed-loop rounds, so prove the swap END state explicitly — v2
+    # serves byte-identically and the retired v1 entry released its
+    # device buffers once its last in-flight request finished
+    for i in range(16):
+        start, rows = slice_for(i, rounds)
+        fut = daemon.submit("higgs", rows)
+        out = fut.result(timeout=120)
+        versions_seen.add(fut.version)
+        if fut.version != 2 or not np.array_equal(
+                out, expected[2][start:start + req_rows]):
+            failures.append(f"post-swap mismatch v{fut.version}@{start}")
+    if not v1_handle.entry.released:
+        failures.append("retired v1 entry still holds device buffers")
+
+    recompiles = daemon.registry.serve_recompiles() - warmup_recompiles
+    stats = daemon.stats()
+    daemon.stop(drain=True, timeout=30)
+
+    lat = np.asarray(latencies, np.float64)
+    n_req = streams * rounds
+    hot_swap_ok = (not failures and len(lat) == n_req
+                   and swap_handle.entry is not None
+                   and swap_handle.entry.version == 2
+                   and versions_seen == {1, 2})
+    out = {
+        "metric": "serve_closed_loop",
+        "value": round(float(np.percentile(lat, 99)), 3) if len(lat) else None,
+        "unit": "p99_ms",
+        "serve_p50_ms": round(float(np.percentile(lat, 50)), 3)
+        if len(lat) else None,
+        "serve_p99_ms": round(float(np.percentile(lat, 99)), 3)
+        if len(lat) else None,
+        "serve_rows_per_s": round(rows_served[0] / max(wall, 1e-9), 1),
+        "serve_requests_per_s": round(len(lat) / max(wall, 1e-9), 1),
+        "serve_recompiles": int(recompiles),
+        "streams": streams,
+        "rounds": rounds,
+        "request_rows": req_rows,
+        "requests": int(len(lat)),
+        "rows": int(rows_served[0]),
+        "hot_swap_ok": bool(hot_swap_ok),
+        "versions_seen": sorted(versions_seen),
+        "coalesced_batches": int(stats["serve_batches"]),
+        "coalesce_wait_ms": cfg.serve_max_coalesce_wait_ms,
+        "errors": failures[:5],
+        "backend": jax.default_backend(),
+        "smoke": bool(smoke),
+    }
+    print(json.dumps(out))
+    ok = hot_swap_ok and recompiles == 0
+    return 0 if ok else 1
+
+
 _MULTICHIP_CHILD = r"""
 import os, sys
 sys.path.insert(0, os.environ["BENCH_REPO"])
@@ -607,4 +779,6 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--multichip":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
         sys.exit(multichip_main(n))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--serve":
+        sys.exit(serve_main(smoke="--smoke" in sys.argv[2:]))
     main()

@@ -96,6 +96,9 @@ class DART(GBDT):
                 t.internal_value[:n] = np.asarray(ivals[off:off + n],
                                                   np.float64)
                 off += int(n)
+        # the restored full-precision shrinkage/internal values mutated
+        # the trees in place; repack before any serve
+        self._bump_model_mutations()
         # a checkpoint resume CONTINUES the same DART run: the adopted
         # trees must stay droppable, so fold them back into `iter_`
         # (continue_from counted them as frozen init trees).  Every
@@ -154,6 +157,12 @@ class DART(GBDT):
                 tree = self.models_[i * K + k]
                 tree.apply_shrinkage(-1.0)
                 self._add_tree_score(tree, k, valid=False)
+        if self.drop_index_:
+            # the in-place leaf re-weighting invalidates the packed and
+            # device predictor caches: a predict between drop and
+            # normalize (serving a live DART booster) must repack so it
+            # scores the CURRENT drop state, matching Booster.predict
+            self._bump_model_mutations()
         k_cnt = float(len(self.drop_index_))
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + k_cnt)
@@ -192,3 +201,7 @@ class DART(GBDT):
                                          / (k_cnt + cfg.learning_rate))
                     self.tree_weight_[j] *= (k_cnt
                                              / (k_cnt + cfg.learning_rate))
+        if self.drop_index_:
+            # normalization re-weighted the dropped trees in place — a
+            # mid-training DART model must serve its current weights
+            self._bump_model_mutations()

@@ -1858,6 +1858,13 @@ class GBDT:
         return out
 
     # ---------------------------------------------------------------- predict
+    def _bump_model_mutations(self) -> None:
+        """Invalidate the packed/device predictor caches after an IN-PLACE
+        tree mutation that `len(models_)` cannot see — DART drop/
+        normalize re-weighting, refit, set_leaf_output.  Serving a model
+        mid-mutation must repack, never reuse stale leaf values."""
+        self._model_mutations = getattr(self, "_model_mutations", 0) + 1
+
     def _packed_for(self, start_iteration: int, end: int, K: int):
         """Cached native PackedPredictor for a model slice, invalidated by
         growth (len) and in-place mutation (_model_mutations)."""
@@ -1908,14 +1915,14 @@ class GBDT:
         (the bit-exact routing argument needs float32 inputs; lossless
         float64 — integral features, f32-round-tripped pipelines — is
         downcast and served, the ROADMAP'd Serving follow-up),
-        prediction early stopping (inherently sequential over trees),
         linear-tree models, empty slices, or device_predict=false /
-        auto without a TPU backend."""
+        auto without a TPU backend.  Prediction early stopping serves on
+        device too (traverse.py class_scores_early_stop masked scan);
+        the `pred_early_stop` argument is kept for callers that gate es
+        activation themselves."""
         cfg = self.config
         mode = getattr(cfg, "device_predict", "false") if cfg else "false"
         if mode == "false":
-            return None
-        if pred_early_stop and not self.average_output_:
             return None
         arr = X if isinstance(X, np.ndarray) else np.asarray(X)
         if arr.dtype == np.float32:
@@ -1968,21 +1975,33 @@ class GBDT:
             self._device_pred = cached
         return cached[1]
 
-    def _device_predict_run(self, dp, X, mode: str) -> np.ndarray:
+    def _device_predict_run(self, dp, X, mode: str,
+                            early_stop=None) -> np.ndarray:
         """One device predict dispatch + telemetry (timer scope and a
-        structured `predict` event when an EventLogger is active)."""
+        structured `predict` event when an EventLogger is active).
+        `early_stop=(freq, margin)` routes through the device masked
+        accumulation scan (parity with the host early-stop path)."""
         from ..observability import emit_event
         with global_timer.scope("GBDT::predict_device"):
             if mode == "leaf":
                 out = dp.predict_leaf(X)
             elif mode == "raw":
-                out = dp.predict_raw(X)
+                out = dp.predict_raw(X, early_stop=early_stop)
             else:
-                out = dp.predict(X)
+                out = dp.predict(X, early_stop=early_stop)
         n = out.shape[0]
         emit_event("predict", path="device", mode=mode, rows=int(n),
-                   trees=dp.pack.num_trees, bucket=dp.bucket_rows(n))
+                   trees=dp.pack.num_trees, bucket=dp.bucket_rows(n),
+                   early_stop=early_stop is not None)
         return out
+
+    def _es_tuple(self, pred_early_stop, freq, margin):
+        """(freq, margin) when prediction early stopping engages — same
+        gate as the host path's use_es (off under output averaging,
+        ref: gbdt_prediction.cpp)."""
+        if pred_early_stop and not self.average_output_ and freq > 0:
+            return (int(freq), float(margin))
+        return None
 
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, pred_early_stop: bool = False,
@@ -1995,7 +2014,9 @@ class GBDT:
         hit = self._device_predictor(X, start_iteration, num_iteration,
                                      pred_early_stop)
         if hit is not None:
-            return self._device_predict_run(hit[0], hit[1], "raw")
+            es = self._es_tuple(pred_early_stop, pred_early_stop_freq,
+                                pred_early_stop_margin)
+            return self._device_predict_run(hit[0], hit[1], "raw", es)
         with global_timer.scope("GBDT::predict"):
             return self._predict_raw_impl(
                 X, start_iteration, num_iteration, pred_early_stop,
@@ -2061,8 +2082,13 @@ class GBDT:
                 X, start_iteration, num_iteration,
                 pred_kwargs.get("pred_early_stop", False))
             if hit is not None:
+                es = self._es_tuple(
+                    pred_kwargs.get("pred_early_stop", False),
+                    pred_kwargs.get("pred_early_stop_freq", 10),
+                    pred_kwargs.get("pred_early_stop_margin", 10.0))
                 # convert_output fused into the device program
-                return self._device_predict_run(hit[0], hit[1], "convert")
+                return self._device_predict_run(hit[0], hit[1], "convert",
+                                                es)
         raw = self.predict_raw(X, start_iteration, num_iteration,
                                **pred_kwargs)
         if raw_score or self.objective is None:

@@ -156,7 +156,13 @@ def load_model_from_string(text: str):
                     "alpha": "alpha", "c": "fair_c",
                     "tweedie_variance_power": "tweedie_variance_power"}
                    .get(k, k)] = v
+    prev_verbosity = log.get_verbosity()
     cfg = Config(params)
+    # the predictor-mode Config is built quiet (verbosity -1 above), but
+    # Config._post_process sets the PROCESS-WIDE log level as a side
+    # effect — restore it, or loading any model silences the host (the
+    # serving daemon loads models mid-flight and must keep its logs)
+    log.set_verbosity(prev_verbosity)
     booster.config = cfg
     try:
         obj = create_objective(cfg)

@@ -155,6 +155,57 @@ def _task_save_binary(cfg: Config, params: Dict[str, str]) -> None:
     log.info(f"Saved binary dataset to {out}")
 
 
+def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
+    """Long-lived multi-model serving daemon (docs/Serving.md):
+    `python -m lightgbm_tpu serve serve_models=name=model.txt [...]`.
+    Loads + warms every model (bucket-ladder compiles) BEFORE serving,
+    optionally exposes the line-JSON TCP front end (serve_port=0 for an
+    ephemeral port), and treats SIGTERM as a drain notice — queued
+    requests complete, a final `serve_drain` event lands, exit stays
+    143 (the supervisor's *preempt* classification)."""
+    import time as _time
+
+    from .serving import ServingDaemon, start_frontend
+
+    if cfg.metrics_dir:
+        # serve_* events (swap/evict/drain) land in the standard JSONL
+        # event log, same as training telemetry
+        from .observability import set_event_logger
+        from .observability.events import EventLogger
+        set_event_logger(EventLogger(cfg.metrics_dir,
+                                     rotate_mb=cfg.metrics_rotate_mb))
+    entries = []
+    for tok in cfg.serve_models:
+        name, sep, path = tok.partition("=")
+        if not sep:
+            name, path = os.path.splitext(os.path.basename(tok))[0], tok
+        entries.append((name.strip(), path.strip()))
+    if not entries and cfg.input_model:
+        entries.append(("default", cfg.input_model))
+    if not entries:
+        log.fatal("task=serve needs serve_models=name=model.txt[,...] "
+                  "or input_model=<file>")
+    daemon = ServingDaemon(cfg)
+    for name, path in entries:
+        daemon.registry.register(name, model_file=path, block=True)
+        log.info(f"Serving model {name!r} from {path} (warmed)")
+    daemon.start()
+    daemon.install_signal_handlers()
+    srv = None
+    if cfg.serve_port >= 0:
+        srv = start_frontend(daemon, port=cfg.serve_port)
+    log.info(f"Serving {len(entries)} model(s); SIGTERM drains and exits")
+    try:
+        while not daemon.stopped:
+            _time.sleep(0.2)
+    except KeyboardInterrupt:
+        log.info("Interrupted; draining the request queue")
+        daemon.stop(drain=True, timeout=cfg.serve_drain_timeout_s)
+    finally:
+        if srv is not None:
+            srv.shutdown()
+
+
 def _task_convert_model(cfg: Config, params: Dict[str, str]) -> None:
     """Model -> standalone C-like if-else source
     (ref: gbdt_model_text.cpp SaveModelToIfElse)."""
@@ -242,6 +293,9 @@ def _maybe_init_distributed(cfg: Config) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # `python -m lightgbm_tpu serve k=v ...` sugar for task=serve
+        argv = ["task=serve"] + list(argv[1:])
     params = parse_args(argv)
     cfg = Config(dict(params))
     _maybe_init_distributed(cfg)
@@ -250,6 +304,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "prediction": _task_predict, "refit": _task_refit,
                 "refit_tree": _task_refit,
                 "save_binary": _task_save_binary,
+                "serve": _task_serve,
                 "convert_model": _task_convert_model}
     if task not in handlers:
         log.fatal(f"Unknown task {task!r}")

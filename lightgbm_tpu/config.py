@@ -277,6 +277,32 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # smallest padded batch of the device predictor's bucket ladder;
     # buckets double from here so varying request sizes never recompile
     ("device_predict_min_bucket", "int", 4096, ("predict_min_bucket",)),
+    # --- serving (docs/Serving.md) ---
+    # models the serve task loads at startup: "name=path" entries (a
+    # bare path serves under its file stem); task=serve also serves
+    # input_model= as "default" when this list is empty
+    ("serve_models", "str_list", [], ("serve_model",)),
+    # request coalescing: after popping the first queued request the
+    # dispatcher waits up to this long for more to merge into one padded
+    # bucket dispatch — the explicit batching-efficiency vs p99 trade
+    # (0 = dispatch whatever is already queued, lowest latency)
+    ("serve_max_coalesce_wait_ms", "float", 2.0, ("coalesce_wait_ms",)),
+    # bounded request queue: a saturated device backpressures submitters
+    # instead of buffering unboundedly
+    ("serve_queue_depth", "int", 1024, ()),
+    # row cap per coalesced dispatch; also the top of the warmup bucket
+    # ladder (every bucket up to this size compiles before a model entry
+    # goes live, so steady-state serving never traces)
+    ("serve_max_batch_rows", "int", 65536, ()),
+    # compile the bucket ladder on the background load thread before the
+    # hot swap; false = first requests pay the compiles (debug only)
+    ("serve_warmup", "bool", True, ()),
+    # TCP front end port for task=serve: -1 = in-process only,
+    # 0 = ephemeral (logged), >0 = fixed port
+    ("serve_port", "int", -1, ()),
+    # bound on the SIGTERM drain: queued requests older than this are
+    # failed so a preemption notice cannot stall the exit indefinitely
+    ("serve_drain_timeout_s", "float", 10.0, ()),
     ("start_iteration_predict", "int", 0, ()),
     ("num_iteration_predict", "int", -1, ()),
     ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),

@@ -25,13 +25,15 @@ exactly like the reference workers each holding the whole model).
 from __future__ import annotations
 
 import warnings
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from ..utils import log
+from ..utils.timer import global_timer
 from .pack import PackedEnsemble, pack_ensemble
-from .traverse import class_scores, ensemble_leaf_ids
+from .traverse import (class_scores, class_scores_early_stop,
+                       ensemble_leaf_ids)
 
 
 class DevicePredictor:
@@ -103,13 +105,39 @@ class DevicePredictor:
         return sum(fn.signatures_seen for (m, _, _), fn in self._fns.items()
                    if m == mode)
 
+    def total_traces(self) -> int:
+        """Distinct traced signatures across EVERY compiled entry (all
+        modes, buckets, feature counts) — the serving registry's
+        `serve_recompiles` accounting reads this before and after the
+        warmup ladder."""
+        return sum(fn.signatures_seen for fn in self._fns.values())
+
+    def release_device(self) -> None:
+        """Drop the device copies of the pack and every compiled entry so
+        an evicted serving model frees its buffers; the predictor can be
+        re-armed by the next predict (a fresh put + compile)."""
+        self._dev = None
+        self._fns = {}
+
     # ------------------------------------------------------------ program
-    def _program(self, mode: str):
+    def _program(self, mode: str, es_freq: int = 0):
         p = self.pack
         depth = p.max_depth
         K = self.num_class
         average = self.average
         convert = self._convert
+
+        if es_freq > 0:
+            def run_es(x, margin, sf, th, mt, dl, ic, lc, rc, lv, cs, cn,
+                       cw):
+                leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
+                                         cs, cn, cw, depth)
+                scores = class_scores_early_stop(leaf, lv, K, es_freq,
+                                                 margin)
+                if mode == "convert" and convert is not None:
+                    scores = convert(scores.T).T
+                return scores
+            return run_es
 
         def run(x, sf, th, mt, dl, ic, lc, rc, lv, cs, cn, cw):
             leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
@@ -124,20 +152,23 @@ class DevicePredictor:
 
         return run
 
-    def _fn_for(self, mode: str, bucket: int, F: int):
-        key = (mode, bucket, F)
+    def _fn_for(self, mode: str, bucket: int, F: int, es_freq: int = 0):
+        mode_key = f"{mode}+es{es_freq}" if es_freq > 0 else mode
+        key = (mode_key, bucket, F)
         fn = self._fns.get(key)
         if fn is None:
             import jax
             from ..observability import RecompileDetector
-            jitted = jax.jit(self._program(mode), donate_argnums=(0,))
+            jitted = jax.jit(self._program(mode, es_freq),
+                             donate_argnums=(0,))
             fn = RecompileDetector(
-                jitted, f"device_predict[{mode}@{bucket}]")
+                jitted, f"device_predict[{mode_key}@{bucket}]")
             self._fns[key] = fn
         return fn
 
     # ------------------------------------------------------------ predict
-    def _run(self, X: np.ndarray, mode: str):
+    def _run(self, X: np.ndarray, mode: str,
+             early_stop: Optional[Tuple[int, float]] = None):
         import jax
         X = np.ascontiguousarray(X, np.float32)
         if X.ndim == 1:
@@ -147,6 +178,15 @@ class DevicePredictor:
             log.fatal(f"The model references feature index "
                       f"{self.pack.max_feature} but the data has only "
                       f"{F} columns")
+        es_freq = 0
+        extra = ()
+        if early_stop is not None and mode != "leaf" and not self.average:
+            # early stopping with output averaging is a no-op host-side
+            # too (gbdt.py use_es); the margin rides as a traced scalar
+            # so threshold changes never re-trace
+            es_freq = max(int(early_stop[0]), 0)
+            if es_freq > 0:
+                extra = (np.float32(early_stop[1]),)
         bucket = self.bucket_rows(n)
         if bucket != n:
             xp = np.zeros((bucket, F), np.float32)
@@ -160,23 +200,54 @@ class DevicePredictor:
             # donation frees the input pages for scratch, which is the point
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            out = self._fn_for(mode, bucket, F)(xd, *self._device_arrays())
+            with global_timer.scope("DevicePredictor::dispatch"):
+                out = self._fn_for(mode, bucket, F, es_freq)(
+                    xd, *extra, *self._device_arrays())
+                # when timing, settle here so dispatch vs device time
+                # split into ::dispatch / ::dispatch::device scopes
+                out = global_timer.block(out)
         return np.asarray(out)[:n], bucket
+
+    def warmup(self, num_features: int, max_rows: int,
+               modes=("convert", "raw"),
+               early_stop: Optional[Tuple[int, float]] = None) -> int:
+        """Compile the whole bucket ladder for `num_features`-wide inputs
+        up through the bucket covering `max_rows` — the serving
+        registry runs this on a background thread BEFORE a model entry
+        goes live, so the first real request never pays a compile.
+        Returns the number of traced signatures."""
+        if not self.ok:
+            return 0
+        b = self._min_bucket
+        while True:
+            x = np.zeros((b, num_features), np.float32)
+            for mode in modes:
+                self._run(x, mode, early_stop=early_stop)
+            if b >= max_rows:
+                break
+            b *= 2
+        return self.total_traces()
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """[n, T] int32 leaf indices — bit-identical to the native
         predictor's routing for float32 inputs."""
         return self._run(X, "leaf")[0]
 
-    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+    def predict_raw(self, X: np.ndarray,
+                    early_stop: Optional[Tuple[int, float]] = None
+                    ) -> np.ndarray:
         """Raw scores [n] (K == 1) or [n, K]; float32 accumulation of the
-        float64 leaf values (routing exact; see docs/Inference.md)."""
-        out, _ = self._run(X, "raw")
+        float64 leaf values (routing exact; see docs/Inference.md).
+        `early_stop=(freq, margin)` runs the masked accumulation scan
+        (prediction early stopping, traverse.py)."""
+        out, _ = self._run(X, "raw", early_stop=early_stop)
         return out[:, 0] if self.num_class == 1 else out
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
+    def predict(self, X: np.ndarray,
+                early_stop: Optional[Tuple[int, float]] = None
+                ) -> np.ndarray:
         """Converted predictions with the objective's convert_output fused
         on device (raw scores when no converter was given)."""
         mode = "convert" if self._convert is not None else "raw"
-        out, _ = self._run(X, mode)
+        out, _ = self._run(X, mode, early_stop=early_stop)
         return out[:, 0] if self.num_class == 1 else out

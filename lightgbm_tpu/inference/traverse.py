@@ -86,16 +86,70 @@ def ensemble_leaf_ids(x, split_feature, threshold, missing_type,
     return jnp.invert(node)
 
 
-def class_scores(leaf, leaf_value, num_class: int, average: bool):
-    """Leaf ids [B, T] + values [T, NL] -> raw scores [B, K] (tree t
-    belongs to class t % K; ref: predict.c lgbt_predict_batch)."""
+def _leaf_values(leaf, leaf_value):
+    """Leaf ids [B, T] + values [T, NL] -> per-tree contributions [B, T]."""
     T, NL = leaf_value.shape
     flat = leaf_value.reshape(-1)
     g = leaf + (jnp.arange(T, dtype=jnp.int32) * jnp.int32(NL))[None, :]
-    vals = jnp.take(flat, g, mode="clip")            # [B, T]
+    return jnp.take(flat, g, mode="clip")
+
+
+def class_scores(leaf, leaf_value, num_class: int, average: bool):
+    """Leaf ids [B, T] + values [T, NL] -> raw scores [B, K] (tree t
+    belongs to class t % K; ref: predict.c lgbt_predict_batch)."""
+    vals = _leaf_values(leaf, leaf_value)            # [B, T]
     B = vals.shape[0]
+    T = leaf_value.shape[0]
     iters = T // num_class if num_class else 0
     scores = vals.reshape(B, iters, num_class).sum(axis=1)
     if average and iters > 0:
         scores = scores / jnp.float32(iters)         # gbdt_prediction.cpp:57
     return scores
+
+
+def class_scores_early_stop(leaf, leaf_value, num_class: int, freq: int,
+                            margin):
+    """Raw scores with prediction early stopping as a masked accumulation
+    scan (ref: prediction_early_stop.cpp; gbdt.py _predict_raw_impl is
+    the host mirror).
+
+    The traversal already settled every (row, tree) leaf in one pass —
+    on a vector machine there is nothing to skip — but early stopping
+    CHANGES THE ANSWER: a row whose margin clears the threshold at a
+    round check keeps its partial sum and ignores all later trees.  So
+    the accumulation replays the host's sequential semantics as a
+    lax.scan over iterations: before adding iteration i (i > 0, i %
+    freq == 0) the margin of the running sum is tested — binary margin
+    = 2|score| (ref: CreateBinaryPredictionEarlyStopInstance),
+    multiclass = top1 - top2 (CreateMulticlassPredictionEarlyStopInstance)
+    — and rows past it stop accumulating via a per-row done mask.
+
+    `freq` is static (it shapes the check pattern); `margin` is a traced
+    f32 scalar so sweeping thresholds never re-traces the program.
+    """
+    vals = _leaf_values(leaf, leaf_value)            # [B, T]
+    B = vals.shape[0]
+    T = leaf_value.shape[0]
+    K = max(num_class, 1)
+    iters = T // K
+    vals = jnp.moveaxis(vals.reshape(B, iters, K), 1, 0)  # [iters, B, K]
+
+    def body(carry, xs):
+        acc, done = carry
+        v_i, i = xs
+        if K == 1:
+            m = jnp.float32(2.0) * jnp.abs(acc[:, 0])
+        else:
+            top2 = jax.lax.top_k(acc, 2)[0]
+            m = top2[:, 0] - top2[:, 1]
+        check = (i > jnp.int32(0)) & (i % jnp.int32(freq) == jnp.int32(0))
+        done = done | (check & (m > margin))
+        acc = acc + jnp.where(done[:, None], jnp.float32(0), v_i)
+        return (acc, done), None
+
+    acc0 = jnp.zeros((B, K), jnp.float32)
+    done0 = jnp.zeros((B,), jnp.bool_)
+    (acc, _), _ = jax.lax.scan(
+        body, (acc0, done0),
+        (vals, jnp.arange(iters, dtype=jnp.int32)))
+    return acc

@@ -64,6 +64,11 @@ _HASH_EXCLUDE = frozenset((
     "metrics_dir", "metrics_rotate_mb", "profile_dir",
     "async_host_io", "compile_cache_dir", "device_eval",
     "device_predict", "device_predict_min_bucket",
+    # serving-daemon knobs (docs/Serving.md): pure inference-side
+    # configuration, model-neutral by construction
+    "serve_models", "serve_max_coalesce_wait_ms", "serve_queue_depth",
+    "serve_max_batch_rows", "serve_warmup", "serve_port",
+    "serve_drain_timeout_s",
     # the degradation ladder (reliability/guard.py) flips these between
     # attempts; all are model-neutral perf/telemetry knobs, and a
     # degraded relaunch MUST still resume the interrupted checkpoint

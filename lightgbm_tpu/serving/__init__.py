@@ -1,0 +1,22 @@
+"""Persistent multi-model serving daemon (docs/Serving.md).
+
+The "millions of users" layer over the device inference stack: a
+long-lived process that owns the device and composes the compiled
+bucket ladder (inference/), a hot-swap model registry (registry.py),
+and a request coalescer (coalescer.py) into sustained throughput with
+bounded tail latency.  `python -m lightgbm_tpu serve` is the CLI front
+end; `ServingClient` the in-process API; `bench.py --serve` the
+closed-loop p50/p99 bench.
+"""
+
+from .coalescer import Coalescer, ServeFuture, ServeRequest
+from .daemon import ServingClient, ServingDaemon, serve_counters_reset
+from .frontend import ServeFrontend, start_frontend
+from .registry import LoadHandle, ModelEntry, ModelRegistry
+
+__all__ = [
+    "Coalescer", "ServeFuture", "ServeRequest",
+    "ServingClient", "ServingDaemon", "serve_counters_reset",
+    "ServeFrontend", "start_frontend",
+    "LoadHandle", "ModelEntry", "ModelRegistry",
+]

@@ -1,0 +1,231 @@
+"""ServingDaemon: one long-lived process owning the device, serving many
+models (docs/Serving.md).
+
+Composition of parts that already existed: the compiled bucket ladder +
+slice-keyed packing (inference/, PR 4), the persistent compile cache
+(PR 5), and the SIGTERM drain machinery (observability/hostio.py, PRs
+7-8) — the daemon wires them behind a model registry (hot swap) and a
+request coalescer (tail-latency-bounded batching).  The reference's
+analogue is the long-lived `Predictor` the CLI keeps per model
+(ref: src/application/predictor.hpp); "millions of users" needs that
+predictor to be multi-model, swap-safe, and batched.
+
+Request path: `submit()` validates and copies the rows to an immutable
+float32 matrix (float64 accepted when losslessly f32-representable —
+the same exactness gate as GBDT._device_predictor), acquires the
+CURRENT registry entry, and queues; the coalescer thread merges queued
+requests into one padded bucket dispatch and splits the rows back.
+SIGTERM = drain notice: `install_signal_handlers()` reuses the
+preemption-hook slot so a supervisor kill completes every queued
+request, emits a final `serve_drain` event, flushes host I/O, and
+re-delivers the signal (exit stays 143).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..observability import emit_event
+from ..observability.registry import LatencyWindow, global_registry
+from ..utils import log
+from .coalescer import Coalescer, ServeFuture, ServeRequest
+from .registry import ModelRegistry
+
+_MODES = ("predict", "raw", "leaf")
+
+
+def _as_f32_rows(X) -> np.ndarray:
+    """Validate + copy request rows to an immutable float32 matrix.
+
+    The copy is deliberate: the request sits in a queue after submit
+    returns, so serving must never alias caller-owned memory the caller
+    may mutate.  float64 is accepted only when losslessly
+    f32-representable (NaN kept as missing) — the bit-exact routing
+    argument (docs/Inference.md) needs float32 inputs; lossy float64
+    is the caller's error, not a silent precision downgrade."""
+    arr = np.asarray(X)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if arr.ndim != 2 or arr.shape[0] == 0:
+        raise ValueError(f"Serving rows must be a non-empty 2-D matrix "
+                         f"(got shape {arr.shape})")
+    if arr.dtype == np.float32:
+        return np.array(arr, np.float32, copy=True)
+    if arr.dtype == np.float64 or np.issubdtype(arr.dtype, np.integer):
+        x64 = arr.astype(np.float64, copy=False)
+        x32 = x64.astype(np.float32)
+        if bool(np.all((x32 == x64) | np.isnan(x64))):
+            return x32
+        raise ValueError(
+            "float64 request is not losslessly float32-representable; "
+            "the device traversal serves float32 (docs/Serving.md "
+            "fallback matrix) — downcast client-side to accept the "
+            "rounding")
+    raise ValueError(f"Unsupported request dtype {arr.dtype}")
+
+
+class ServingDaemon:
+    """Long-lived multi-model serving daemon (threads front end).
+
+    Parameters arrive as a `Config` (or `key=value` params), using the
+    `serve_*` family plus `device_predict_min_bucket` and the
+    `pred_early_stop*` knobs (early stopping runs device-side via the
+    masked accumulation scan, so it serves with zero extra traces)."""
+
+    def __init__(self, config: Optional[Config] = None, **params):
+        if config is None:
+            config = Config(params)
+        self.config = config
+        es: Optional[Tuple[int, float]] = None
+        if config.pred_early_stop and config.pred_early_stop_freq > 0:
+            es = (int(config.pred_early_stop_freq),
+                  float(config.pred_early_stop_margin))
+        self._early_stop = es
+        self.latency = LatencyWindow()
+        self.registry = ModelRegistry(
+            min_bucket=config.device_predict_min_bucket,
+            warmup_rows=config.serve_max_batch_rows,
+            warmup=config.serve_warmup, early_stop=es)
+        self.coalescer = Coalescer(
+            max_wait_ms=config.serve_max_coalesce_wait_ms,
+            queue_depth=config.serve_queue_depth,
+            max_batch_rows=config.serve_max_batch_rows,
+            latency_window=self.latency)
+        self._stopped = threading.Event()
+
+    # -------------------------------------------------------------- control
+    def start(self) -> "ServingDaemon":
+        self.coalescer.start()
+        emit_event("serve_start", pid=os.getpid(),
+                   max_coalesce_wait_ms=self.config
+                   .serve_max_coalesce_wait_ms,
+                   queue_depth=self.config.serve_queue_depth,
+                   max_batch_rows=self.config.serve_max_batch_rows)
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> bool:
+        """Stop serving: reject new submits, optionally complete the
+        queued backlog (bounded), then retire every model.  Idempotent."""
+        if self._stopped.is_set():
+            return True
+        drained = self.coalescer.stop(drain=drain, timeout=timeout)
+        self.registry.close()
+        self._stopped.set()
+        emit_event("serve_stop", drained=drained,
+                   requests=int(global_registry.counter("serve_requests")))
+        return drained
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM = drain notice: complete the queued requests (bounded
+        by serve_drain_timeout_s), emit `serve_drain`, flush host I/O,
+        re-deliver — the daemon analogue of training's
+        checkpoint-on-demand preemption hook, riding the exact same
+        hostio machinery (install_sigterm_flush + preemption hook)."""
+        from ..observability import install_sigterm_flush, set_preemption_hook
+        ok = install_sigterm_flush()
+        if ok:
+            set_preemption_hook(self._sigterm_drain)
+        return ok
+
+    def _sigterm_drain(self):
+        pending = self.coalescer.pending
+        drained = self.stop(drain=True,
+                            timeout=self.config.serve_drain_timeout_s)
+        from ..observability.events import emit_event_sync
+        try:
+            emit_event_sync(
+                "serve_drain", pending_at_signal=int(pending),
+                drained=bool(drained),
+                requests=int(global_registry.counter("serve_requests")))
+        except Exception:  # noqa: BLE001 - dying anyway; flush next
+            pass
+        return None  # finish_preemption() flushes and re-delivers
+
+    # -------------------------------------------------------------- serving
+    def submit(self, model: str, X, mode: str = "predict") -> ServeFuture:
+        """Queue one request; returns its future.  Rejects (without
+        queueing) unknown models, bad dtypes/shapes and feature-count
+        mismatches — a malformed request must fail ITS caller, never
+        poison a coalesced bucket or force a fresh trace."""
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES} (got {mode!r})")
+        rows = _as_f32_rows(X)
+        entry = self.registry.get(model)   # acquired; release on response
+        try:
+            if rows.shape[1] != entry.num_features:
+                raise ValueError(
+                    f"Model {model!r} serves {entry.num_features} "
+                    f"features, request has {rows.shape[1]} (a varying "
+                    "width would re-trace the bucket program)")
+            req = ServeRequest(entry, rows, mode,
+                               early_stop=self._early_stop)
+            self.coalescer.submit(req)
+            return req.future
+        except BaseException:
+            entry.release()
+            raise
+
+    def predict(self, model: str, X, mode: str = "predict",
+                timeout: Optional[float] = None):
+        """Blocking convenience wrapper over submit()."""
+        return self.submit(model, X, mode=mode).result(timeout=timeout)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, object]:
+        p50, p99 = self.latency.percentiles((50.0, 99.0))
+        out = {
+            "serve_requests": global_registry.counter("serve_requests"),
+            "serve_rows": global_registry.counter("serve_rows"),
+            "serve_batches": global_registry.counter("serve_batches"),
+            "serve_dispatches": global_registry.counter("serve_dispatches"),
+            "serve_errors": global_registry.counter("serve_errors"),
+            "serve_swaps": global_registry.counter("serve_swaps"),
+            "serve_p50_ms": p50,
+            "serve_p99_ms": p99,
+            "queue_pending": self.coalescer.pending,
+        }
+        out.update(self.registry.stats())
+        return out
+
+
+class ServingClient:
+    """In-process client handle for a ServingDaemon — the API surface a
+    front end (socket, RPC) would wrap.  Thread-safe: any number of
+    client threads may call concurrently (that is the point)."""
+
+    def __init__(self, daemon: ServingDaemon):
+        self._daemon = daemon
+
+    def predict(self, model: str, X, mode: str = "predict",
+                timeout: Optional[float] = None):
+        return self._daemon.predict(model, X, mode=mode, timeout=timeout)
+
+    def predict_async(self, model: str, X,
+                      mode: str = "predict") -> ServeFuture:
+        return self._daemon.submit(model, X, mode=mode)
+
+    def models(self):
+        return self._daemon.registry.names()
+
+    def stats(self):
+        return self._daemon.stats()
+
+
+def serve_counters_reset() -> None:
+    """Zero the serve_* counters (tests and the bench isolate phases);
+    the registry is process-global, so only the serving keys reset."""
+    for key in ("serve_requests", "serve_rows", "serve_batches",
+                "serve_dispatches", "serve_errors", "serve_swaps",
+                "serve_load_failures"):
+        global_registry.inc(key, -global_registry.counter(key))
+    log.debug("serve counters reset")

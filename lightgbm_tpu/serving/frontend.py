@@ -1,0 +1,83 @@
+"""Optional line-delimited-JSON TCP front end for the serving daemon.
+
+One request per line, one response per line (the reference CLI's
+analogue is file-in/file-out prediction; a daemon needs a wire):
+
+    {"model": "m", "rows": [[...], ...], "mode": "predict"}
+      -> {"ok": true, "version": 2, "preds": [...]}
+    {"op": "stats"}      -> {"ok": true, "stats": {...}}
+    {"op": "models"}     -> {"ok": true, "models": [...]}
+
+Deliberately minimal: newline-framed JSON over TCP is debuggable with
+`nc`, needs no dependency, and each connection gets its own handler
+thread (socketserver.ThreadingTCPServer) feeding the SAME coalescer —
+concurrent connections batch together exactly like in-process clients.
+Malformed input answers `{"ok": false, "error": ...}` on that line and
+keeps the connection; serving errors never kill the server.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+
+import numpy as np
+
+from ..utils import log
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def _reply(self, obj) -> None:
+        self.wfile.write((json.dumps(obj) + "\n").encode())
+        self.wfile.flush()
+
+    def handle(self) -> None:
+        daemon = self.server.serving_daemon
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                op = msg.get("op", "predict")
+                if op == "stats":
+                    self._reply({"ok": True, "stats": daemon.stats()})
+                    continue
+                if op == "models":
+                    self._reply({"ok": True,
+                                 "models": daemon.registry.names()})
+                    continue
+                rows = np.asarray(msg["rows"], np.float64)
+                fut = daemon.submit(msg.get("model", "default"), rows,
+                                    mode=msg.get("mode", "predict"))
+                out = fut.result(timeout=self.server.request_timeout_s)
+                self._reply({"ok": True, "version": fut.version,
+                             "latency_ms": round(fut.latency_ms, 3),
+                             "preds": np.asarray(out).tolist()})
+            except Exception as e:  # noqa: BLE001 - per-line error reply
+                try:
+                    self._reply({"ok": False, "error": str(e)})
+                except OSError:
+                    return  # peer went away mid-reply
+
+
+class ServeFrontend(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def start_frontend(daemon, port: int = 0, host: str = "127.0.0.1",
+                   request_timeout_s: float = 60.0) -> ServeFrontend:
+    """Bind (port 0 = ephemeral) and serve on a background thread.
+    Returns the server; `server.server_address[1]` is the bound port and
+    `server.shutdown()` stops it (the daemon drain path calls that)."""
+    srv = ServeFrontend((host, int(port)), _Handler)
+    srv.serving_daemon = daemon
+    srv.request_timeout_s = float(request_timeout_s)
+    t = threading.Thread(target=srv.serve_forever,
+                         name="lgbm-serve-frontend", daemon=True)
+    t.start()
+    log.info(f"Serving front end listening on "
+             f"{srv.server_address[0]}:{srv.server_address[1]}")
+    return srv

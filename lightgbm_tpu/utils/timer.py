@@ -22,6 +22,14 @@ Two scope flavours (docs/Observability.md):
   in `jax.named_scope`, so the phase name survives into the compiled
   XLA program and shows up on profiler timelines; the host-side
   accumulation only measures trace time (once per compile).
+
+Device-time attribution: `block(x)` inside a scope additionally credits
+the settle wait to a separate `<scope>::device` entry, so a phase
+breakdown separates HOST dispatch time from DEVICE execution time — the
+serving bench and `timer_top_ms` read both.  The scope stack is
+thread-local (the serving coalescer times dispatches concurrently with
+the main thread); accumulator updates take a lock only when timing is
+enabled, so the production hot path is untouched.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ from __future__ import annotations
 import atexit
 import functools
 import os
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -43,9 +52,17 @@ class Timer:
         self.enabled = enabled
         self._acc: Dict[str, float] = defaultdict(float)
         self._cnt: Dict[str, int] = defaultdict(int)
+        self._alock = threading.Lock()
+        self._tls = threading.local()
         if use_jax_profiler is None:
             use_jax_profiler = bool(os.environ.get("LIGHTGBM_TPU_TRACE", ""))
         self._use_jax_profiler = use_jax_profiler
+
+    def _scope_stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
 
     # ------------------------------------------------------- profiler wiring
     def set_trace_annotations(self, on: bool) -> None:
@@ -69,15 +86,20 @@ class Timer:
             import jax.profiler
             ctx = jax.profiler.TraceAnnotation(name)
             ctx.__enter__()
+        stack = self._scope_stack()
+        stack.append(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
+            stack.pop()
             if ctx is not None:
                 ctx.__exit__(None, None, None)
             if self.enabled:
-                self._acc[name] += time.perf_counter() - t0
-                self._cnt[name] += 1
+                dt = time.perf_counter() - t0
+                with self._alock:
+                    self._acc[name] += dt
+                    self._cnt[name] += 1
 
     @contextmanager
     def device_scope(self, name: str):
@@ -94,14 +116,28 @@ class Timer:
         """block_until_ready(x) when timing is on, so the enclosing scope
         is charged for the device work it dispatched (async dispatch
         otherwise bills whichever later phase syncs first).  Identity
-        when timing is off — production dispatch stays pipelined."""
+        when timing is off — production dispatch stays pipelined.
+
+        The settle wait is ALSO credited to `<enclosing scope>::device`:
+        the enclosing scope's total is unchanged (dispatch + settle, as
+        before), and the ::device entry says how much of it the chip
+        owned — per-phase DEVICE time attribution with no call-site
+        changes."""
         if not self.enabled or x is None:
             return x
+        t0 = time.perf_counter()
         try:
             import jax
-            return jax.block_until_ready(x)
+            x = jax.block_until_ready(x)
         except Exception:
             return x
+        stack = self._scope_stack()
+        if stack:
+            dt = time.perf_counter() - t0
+            with self._alock:
+                self._acc[stack[-1] + "::device"] += dt
+                self._cnt[stack[-1] + "::device"] += 1
+        return x
 
     def timeit(self, name: str):
         """Decorator form."""
@@ -115,18 +151,22 @@ class Timer:
 
     # --------------------------------------------------------------- results
     def items(self) -> Tuple[Tuple[str, float, int], ...]:
-        return tuple((k, self._acc[k], self._cnt[k])
-                     for k in sorted(self._acc, key=self._acc.get,
-                                     reverse=True))
+        with self._alock:
+            acc = dict(self._acc)
+            cnt = dict(self._cnt)
+        return tuple((k, acc[k], cnt[k])
+                     for k in sorted(acc, key=acc.get, reverse=True))
 
     def snapshot(self) -> Dict[str, Tuple[float, int]]:
         """Point-in-time copy {name: (seconds, calls)} — per-iteration
         phase breakdowns diff two snapshots (observability/events)."""
-        return {k: (self._acc[k], self._cnt[k]) for k in self._acc}
+        with self._alock:
+            return {k: (self._acc[k], self._cnt[k]) for k in self._acc}
 
     def reset(self) -> None:
-        self._acc.clear()
-        self._cnt.clear()
+        with self._alock:
+            self._acc.clear()
+            self._cnt.clear()
 
     def print(self) -> None:
         """ref: Timer::Print at process exit."""

@@ -226,13 +226,93 @@ def test_float64_lossy_falls_back_to_host(binary_cat):
         g.config.device_predict = "false"
 
 
-def test_pred_early_stop_falls_back(binary_cat):
+def test_pred_early_stop_device_matches_host(binary_cat):
+    """Device early stopping (traverse.py masked accumulation scan) must
+    reproduce the host path's SEMANTICS: rows whose margin clears the
+    threshold at a round check keep their partial sum.  Scores agree to
+    f32 accumulation rounding; a small margin must actually change the
+    answer (rows stopped), a huge margin must stop nobody."""
+    bst, X = binary_cat
+    g = bst._gbdt
+    Xt = _test_points()
+    host_es = g._predict_raw_impl(np.asarray(Xt, np.float64), 0, -1,
+                                  True, 2, 0.2)
+    host_plain = g._predict_raw_impl(np.asarray(Xt, np.float64), 0, -1,
+                                     False, 10, 10.0)
+    assert not np.allclose(host_es, host_plain)  # es engaged host-side
+    g.config.device_predict = "true"
+    try:
+        dev_es = g.predict_raw(Xt, pred_early_stop=True,
+                               pred_early_stop_freq=2,
+                               pred_early_stop_margin=0.2)
+        np.testing.assert_allclose(dev_es, host_es, rtol=1e-5, atol=1e-5)
+        dev_off = g.predict_raw(Xt, pred_early_stop=True,
+                                pred_early_stop_freq=2,
+                                pred_early_stop_margin=1e9)
+        np.testing.assert_allclose(dev_off, host_plain,
+                                   rtol=RTOL, atol=ATOL)
+    finally:
+        g.config.device_predict = "false"
+
+
+def test_pred_early_stop_device_multiclass(multiclass):
+    """Multiclass margin = top1 - top2 (prediction_early_stop.cpp)."""
+    bst, X = multiclass
+    g = bst._gbdt
+    Xt = np.asarray(X[:200], np.float32)
+    host_es = g._predict_raw_impl(np.asarray(Xt, np.float64), 0, -1,
+                                  True, 2, 0.02)
+    g.config.device_predict = "true"
+    try:
+        dev_es = g.predict_raw(Xt, pred_early_stop=True,
+                               pred_early_stop_freq=2,
+                               pred_early_stop_margin=0.02)
+        np.testing.assert_allclose(dev_es, host_es, rtol=1e-5, atol=1e-5)
+    finally:
+        g.config.device_predict = "false"
+
+
+def test_pred_early_stop_margin_sweep_no_retrace(binary_cat):
+    """The margin rides as a traced f32 scalar: sweeping thresholds and
+    batch sizes inside a bucket re-enters ONE compiled program."""
     bst, X = binary_cat
     g = bst._gbdt
     g.config.device_predict = "true"
     try:
-        assert g._device_predictor(_test_points(), 0, -1,
-                                   pred_early_stop=True) is None
+        g.predict_raw(X[:40], pred_early_stop=True,
+                      pred_early_stop_freq=3, pred_early_stop_margin=0.5)
+        dp = g._device_pred[1]
+        t0 = dp.total_traces()
+        assert any("+es3" in m for (m, _, _) in dp._fns)
+        for margin, n in ((0.1, 17), (2.0, 40), (7.5, 256)):
+            g.predict_raw(X[:n], pred_early_stop=True,
+                          pred_early_stop_freq=3,
+                          pred_early_stop_margin=margin)
+        assert dp.total_traces() == t0
+    finally:
+        g.config.device_predict = "false"
+
+
+def test_dart_inplace_mutation_invalidates_device_cache():
+    """DART re-weights OLD trees in place (drop/normalize); the cached
+    DevicePredictor must repack so a mid-training model serves its
+    CURRENT drop state, matching Booster.predict (ISSUE 10 satellite)."""
+    X, y = _mk_xy(600, seed=21)
+    bst = _train({"boosting": "dart", "drop_rate": 0.9, "skip_drop": 0.0,
+                  "learning_rate": 0.3}, X, y, rounds=5)
+    g = bst._gbdt
+    g.config.device_predict = "true"
+    try:
+        Xt = np.asarray(X[:64], np.float32)
+        before = g.predict_raw(Xt)
+        g.pre_gradient_hook()          # drops trees: in-place -w flip
+        assert g.drop_index_, "no drop fired; raise drop_rate"
+        expected = np.zeros(len(Xt))
+        for t in g.models_:            # semantic truth: current trees
+            expected += t.predict(np.asarray(Xt, np.float64))
+        after = g.predict_raw(Xt)
+        np.testing.assert_allclose(after, expected, rtol=RTOL, atol=1e-5)
+        assert not np.allclose(after, before)   # stale cache would match
     finally:
         g.config.device_predict = "false"
 

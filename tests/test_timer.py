@@ -99,3 +99,65 @@ def test_global_timer_instruments_training():
     finally:
         global_timer.enabled = False
         global_timer.reset()
+
+
+def test_block_attributes_device_time_to_scope():
+    """block() inside a scope credits the settle wait to a separate
+    `<scope>::device` entry (per-phase DEVICE time attribution, ISSUE 10
+    satellite): the scope total still includes the settle, the ::device
+    entry says how much of it the chip owned."""
+    import jax.numpy as jnp
+    t = Timer(enabled=True)
+    with t.scope("Phase"):
+        t.block(jnp.arange(1000) * 2)
+    snap = t.snapshot()
+    assert "Phase" in snap and "Phase::device" in snap
+    assert snap["Phase::device"][0] <= snap["Phase"][0]
+    assert snap["Phase::device"][1] == 1
+    # nested scopes credit the INNERMOST phase
+    t.reset()
+    with t.scope("Outer"):
+        with t.scope("Inner"):
+            t.block(jnp.arange(8))
+    snap = t.snapshot()
+    assert "Inner::device" in snap and "Outer::device" not in snap
+    # no enclosing scope: settle happens, nothing is credited
+    t.reset()
+    t.block(jnp.arange(8))
+    assert t.snapshot() == {}
+
+
+def test_block_outside_scope_disabled_no_attribution():
+    t = Timer(enabled=False)
+    with t.scope("X"):
+        t.block(None)
+    assert t.snapshot() == {}
+
+
+def test_scope_stack_is_thread_local():
+    """The serving coalescer times dispatches concurrently with the main
+    thread: each thread's block() must credit ITS OWN scope."""
+    import threading
+
+    import jax.numpy as jnp
+    t = Timer(enabled=True)
+    done = threading.Event()
+    ready = threading.Event()
+
+    def worker():
+        with t.scope("WorkerPhase"):
+            ready.set()
+            done.wait(timeout=10)
+            t.block(jnp.arange(16))
+
+    th = threading.Thread(target=worker)
+    th.start()
+    ready.wait(timeout=10)
+    with t.scope("MainPhase"):
+        t.block(jnp.arange(16))
+    done.set()
+    th.join(timeout=10)
+    snap = t.snapshot()
+    assert "MainPhase::device" in snap and "WorkerPhase::device" in snap
+    assert snap["MainPhase::device"][1] == 1
+    assert snap["WorkerPhase::device"][1] == 1

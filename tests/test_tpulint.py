@@ -156,6 +156,24 @@ def test_explicit_dtype_covers_inference(tmp_path):
     assert _rules_of(rep) == [("inference/t.py", 4, "explicit-dtype")]
 
 
+def test_explicit_dtype_covers_serving(tmp_path):
+    """ISSUE 10: serving/ coalesces request buckets into jitted
+    dispatches, so it is device-code scope (explicit-dtype and
+    no-device-put-in-loop both key off the same scope list)."""
+    rep = _lint(tmp_path, {"serving/s.py": """
+        import jax
+        import jax.numpy as jnp
+        def pad(reqs, n):
+            buf = jnp.zeros(n)                      # flagged: no dtype
+            for r in reqs:
+                x = jax.device_put(r)               # flagged: put in loop
+            return buf, x
+        """}, rules=["explicit-dtype", "no-device-put-in-loop"])
+    assert _rules_of(rep) == [
+        ("serving/s.py", 5, "explicit-dtype"),
+        ("serving/s.py", 7, "no-device-put-in-loop")]
+
+
 # ------------------------------------------------- no-device-put-in-loop
 def test_no_device_put_in_loop(tmp_path):
     rep = _lint(tmp_path, {

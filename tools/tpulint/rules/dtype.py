@@ -9,8 +9,9 @@ hot tree-growth path every such literal is a latent recompile or an
 accidental f64/i64 promotion under `jax_enable_x64`, so device code
 spells dtypes out.
 
-Scope: learner/, ops/, parallel/, inference/, io/device_bin.py — the
-modules whose arrays feed jitted programs.  Host-side code (metrics,
+Scope: learner/, ops/, parallel/, inference/, serving/, io/device_bin.py
+— the modules whose arrays feed jitted programs (serving/ coalesces and
+dispatches request buckets through them).  Host-side code (metrics,
 plotting, IO parsing) may rely on NumPy-style defaults.
 """
 
@@ -26,7 +27,7 @@ from ..core import Finding, LintContext, Rule, register
 # dtype (e.g. jnp.zeros(shape, dtype) -> 2)
 CONSTRUCTORS = {"zeros": 2, "ones": 2, "full": 3, "arange": 4,
                 "array": 2, "empty": 2, "eye": 3}
-SCOPE_DIRS = ("learner", "ops", "parallel", "inference")
+SCOPE_DIRS = ("learner", "ops", "parallel", "inference", "serving")
 SCOPE_FILES = {os.path.join("io", "device_bin.py")}
 
 

@@ -28,6 +28,13 @@ step "elastic chaos drill (tests/test_elastic.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
 
+step "serving suite (tests/test_serving.py)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
+
+step "serving bench smoke (bench.py --serve --smoke)"
+JAX_PLATFORMS=cpu python bench.py --serve --smoke || fail=1
+
 if [[ "${1:-}" != "--quick" ]]; then
     step "tier-1 (full suite, 870 s cap)"
     rm -f /tmp/_t1.log /tmp/_t1.xml
