@@ -286,6 +286,7 @@ def serve_main(smoke: bool = False) -> int:
     cfg = Config({**params,
                   "serve_max_batch_rows": 4096,
                   "serve_queue_depth": max(streams * 2, 64),
+                  "metrics_port": 0,  # ephemeral /metrics; scraped below
                   "serve_max_coalesce_wait_ms": float(
                       os.environ.get("BENCH_SERVE_WAIT_MS", 2.0))})
     daemon = ServingDaemon(cfg).start()
@@ -367,6 +368,37 @@ def serve_main(smoke: bool = False) -> int:
 
     recompiles = daemon.registry.serve_recompiles() - warmup_recompiles
     stats = daemon.stats()
+
+    # Prometheus scrape gate (docs/Observability.md): the fleet/router
+    # layer consumes GET /metrics, so the bench asserts a parseable page
+    # with the serve counters and tail-latency quantile gauges present
+    metrics_scrape_ok = False
+    scrape_error = None
+    try:
+        import urllib.request
+        port = daemon.metrics_server.port
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        required = ("lgbm_serve_requests", "lgbm_serve_rows",
+                    'lgbm_serve_latency_ms{quantile="0.5"}',
+                    'lgbm_serve_latency_ms{quantile="0.99"}',
+                    "lgbm_serve_queue_pending",
+                    'lgbm_serve_requests_by_model{model="higgs"}')
+        missing = [r for r in required if r not in page]
+        # every exposition line must be a comment or name[{labels}] value
+        malformed = [ln for ln in page.splitlines()
+                     if ln and not ln.startswith("#")
+                     and len(ln.rsplit(" ", 1)) != 2]
+        if missing:
+            scrape_error = f"missing series: {missing}"
+        elif malformed:
+            scrape_error = f"malformed lines: {malformed[:3]}"
+        else:
+            metrics_scrape_ok = True
+    except Exception as e:  # noqa: BLE001 - reported in the JSON line
+        scrape_error = str(e)
+
+    serve_roofline = stats.get("roofline")
     daemon.stop(drain=True, timeout=30)
 
     lat = np.asarray(latencies, np.float64)
@@ -395,12 +427,19 @@ def serve_main(smoke: bool = False) -> int:
         "versions_seen": sorted(versions_seen),
         "coalesced_batches": int(stats["serve_batches"]),
         "coalesce_wait_ms": cfg.serve_max_coalesce_wait_ms,
+        "metrics_scrape_ok": bool(metrics_scrape_ok),
+        "metrics_scrape_error": scrape_error,
+        "serve_measured_mfu": (round(serve_roofline["measured_mfu"], 7)
+                               if serve_roofline
+                               and serve_roofline.get("measured_mfu")
+                               is not None else None),
+        "serve_roofline_bound": (serve_roofline or {}).get("bound"),
         "errors": failures[:5],
         "backend": jax.default_backend(),
         "smoke": bool(smoke),
     }
     print(json.dumps(out))
-    ok = hot_swap_ok and recompiles == 0
+    ok = hot_swap_ok and recompiles == 0 and metrics_scrape_ok
     return 0 if ok else 1
 
 
@@ -622,11 +661,20 @@ def main():
     # phase breakdown (docs/Observability.md): a few EXTRA instrumented
     # iterations AFTER the timed loop — the timers' phase-boundary syncs
     # would de-pipeline the dispatch, so the headline number stays
-    # uninstrumented and comparable with every earlier BENCH_*.json
+    # uninstrumented and comparable with every earlier BENCH_*.json.
+    # The cost model rides the same window: compiled-HLO flop/byte
+    # deltas against the ::device phase times give MEASURED per-phase
+    # MFU and a roofline classification next to the analytic estimate
+    from lightgbm_tpu.observability.costmodel import (backend_peaks,
+                                                      global_cost_model)
     from lightgbm_tpu.utils.timer import global_timer
     timer_prev = global_timer.enabled
+    cost_prev = global_cost_model.enabled
     global_timer.enabled = True
+    global_cost_model.enabled = True
     global_timer.reset()
+    cost_snap0 = global_cost_model.snapshot()
+    timer_snap0 = global_timer.snapshot()
     for _ in range(3):
         booster.update()
         # eval tick, mirroring engine.train's scope: with device eval
@@ -638,6 +686,21 @@ def main():
     all_scopes = global_timer.items()
     timer_top = [[name, round(sec * 1000, 3), cnt]
                  for name, sec, cnt in all_scopes[:10]]
+    phase_secs = {name: sec - timer_snap0.get(name, (0.0, 0))[0]
+                  for name, (sec, _c) in global_timer.snapshot().items()}
+    cost_snap1 = global_cost_model.snapshot()
+    roofline_phases = global_cost_model.phase_roofline(
+        cost_snap0, cost_snap1, phase_secs)
+    # headline measured MFU: total compiled flops of the instrumented
+    # window over its total attributed device seconds (the analytic
+    # b10m_useful_mac_mfu's measured cross-check)
+    _tot_flops = sum(v["flops"] for v in roofline_phases.values())
+    _tot_dev_s = sum(v["device_s"] or 0.0
+                     for v in roofline_phases.values())
+    peak_flops, _peak_bw = backend_peaks()
+    measured_mfu = (_tot_flops / _tot_dev_s / peak_flops
+                    if _tot_dev_s > 0 else None)
+    global_cost_model.enabled = cost_prev
     # host-block attribution (docs/Observability.md): the scopes that
     # synchronize the training thread on device results or host I/O —
     # the boundary the ISSUE-5 work shrinks (device eval metrics, async
@@ -711,6 +774,20 @@ def main():
         # where the time goes: [scope, total_ms, calls] over 3
         # instrumented post-loop iterations (top scopes first)
         "timer_top_ms": timer_top,
+        # compiled-HLO roofline over the same window
+        # (docs/Observability.md): per-phase measured MFU, arithmetic
+        # intensity and compute- vs HBM-bound classification
+        "measured_mfu": (round(measured_mfu, 7)
+                         if measured_mfu is not None else None),
+        "roofline": {g: {"mfu": (round(v["mfu"], 7)
+                                 if v.get("mfu") is not None else None),
+                         "ai": (round(v["arithmetic_intensity"], 4)
+                                if v.get("arithmetic_intensity")
+                                is not None else None),
+                         "bound": v.get("bound"),
+                         "flops": v.get("flops"),
+                         "bytes": v.get("bytes")}
+                     for g, v in roofline_phases.items()},
         # serving throughput per predict path (rows/s; *_rows = measured
         # batch — python is subsampled, device shrinks off-TPU)
         "predict_rows_per_s": predict_rows_per_s,
@@ -752,7 +829,8 @@ def main():
              ("sec_per_iter", "auc", "iters", "vs_baseline_28core_2015",
               "setup_s", "e2e_500iter_s",
               "e2e_500iter_vs_baseline_28core_2015",
-              "useful_mac_mfu", "measured_at")),
+              "useful_mac_mfu", "measured_mfu", "roofline_bound",
+              "measured_vs_useful_mac_ratio", "measured_at")),
             ("oracle_bench_10m.json", "b10m_ref_",
              ("ref_sec_per_iter", "ref_auc_at_iters", "host_cpus"))):
         p = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -137,16 +137,27 @@ def record_metrics(metrics_dir: str = None, logger=None):
     `train(metrics_dir=...)` installs this automatically; pass it
     explicitly (with a shared EventLogger) to co-locate events from
     custom callbacks.  Phase deltas need the global timer: the engine
-    enables it for metrics runs, or set LIGHTGBM_TPU_TIMETAG=1."""
+    enables it for metrics runs, or set LIGHTGBM_TPU_TIMETAG=1.
+
+    With the cost model enabled (param `roofline`, on during metrics
+    runs) the event additionally carries per-phase measured MFU,
+    arithmetic intensity and a compute- vs HBM-bound classification —
+    compiled-HLO flop/byte deltas over the same window as the phase
+    timings (observability/costmodel.py).  Every iteration record is
+    also appended to the always-on flight recorder, so a later stall or
+    crash can dump the recent history it was part of."""
     import time as _time
 
     from .observability import EventLogger, global_registry
+    from .observability.costmodel import global_cost_model
+    from .observability.flightrec import flight_recorder
     from .utils.timer import global_timer
 
     if metrics_dir is None and logger is None:
         raise ValueError("record_metrics needs metrics_dir or a logger")
     state: Dict[str, Any] = {"t": _time.perf_counter(),
-                             "snap": global_timer.snapshot()}
+                             "snap": global_timer.snapshot(),
+                             "cost": global_cost_model.snapshot()}
 
     def _callback(env: CallbackEnv) -> None:
         lg = state.get("logger")
@@ -162,13 +173,25 @@ def record_metrics(metrics_dir: str = None, logger=None):
         snap = global_timer.snapshot()
         prev = state["snap"]
         phases = {}
+        phase_secs = {}
         for name, (sec, _cnt) in snap.items():
             d = sec - prev.get(name, (0.0, 0))[0]
             if d > 0:
+                phase_secs[name] = d
                 phases[name] = round(d, 6)
         state["snap"] = snap
         time_s = now - state["t"]
         state["t"] = now
+
+        # per-phase roofline (docs/Observability.md): compiled flop/byte
+        # deltas over this iteration's window, against the phase's
+        # ::device time — measured MFU, not the bench's analytic guess
+        roofline = None
+        if global_cost_model.enabled:
+            cost = global_cost_model.snapshot()
+            roofline = global_cost_model.phase_roofline(
+                state["cost"], cost, phase_secs) or None
+            state["cost"] = cost
 
         train_evals, valid_evals = {}, {}
         for name, metric, value, _hb in env.evaluation_result_list:
@@ -184,10 +207,26 @@ def record_metrics(metrics_dir: str = None, logger=None):
                      if nl > 1 and hasattr(t, "leaf_depth") else 0)
             trees.append({"leaves": nl, "depth": depth})
         reg = global_registry.snapshot()
-        lg.emit("iteration", iteration=env.iteration + 1,
-                time_s=round(time_s, 6), phases=phases,
-                train=train_evals, valid=valid_evals, trees=trees,
-                counters=reg["counters"], gauges=reg["gauges"])
+        fields = dict(iteration=env.iteration + 1,
+                      time_s=round(time_s, 6), phases=phases,
+                      train=train_evals, valid=valid_evals, trees=trees,
+                      counters=reg["counters"], gauges=reg["gauges"])
+        if roofline:
+            fields["roofline"] = roofline
+        lg.emit("iteration", **fields)
+        # flight recorder: the bounded in-process tail a stall/crash/
+        # SIGUSR2 dump reads back (observability/flightrec.py)
+        device_ms = sum(v for k, v in phase_secs.items()
+                        if k.endswith("::device")) * 1000.0
+        flight_recorder.record_iteration(
+            iteration=env.iteration + 1, time_s=round(time_s, 6),
+            phase_ms={k: round(v * 1000.0, 3)
+                      for k, v in phase_secs.items()},
+            device_ms=round(device_ms, 3),
+            recompiles=reg["counters"].get("recompiles", 0),
+            hbm_bytes=reg["gauges"].get("device_bytes_in_use"),
+            rows_per_s=(round(gbdt.num_data / time_s, 1)
+                        if time_s > 0 else None))
     _callback.order = 50
     return _callback
 

@@ -174,6 +174,10 @@ def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
         from .observability.events import EventLogger
         set_event_logger(EventLogger(cfg.metrics_dir,
                                      rotate_mb=cfg.metrics_rotate_mb))
+        # SIGUSR2 = dump the flight recorder + registry snapshot from
+        # the LIVE daemon without killing it (reliability/faults.py)
+        from .reliability.faults import register_flight_dump_signal
+        register_flight_dump_signal(cfg.metrics_dir)
     entries = []
     for tok in cfg.serve_models:
         name, sep, path = tok.partition("=")

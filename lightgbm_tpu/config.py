@@ -222,6 +222,18 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # bracket training with jax.profiler.start_trace/stop_trace for
     # TensorBoard device timelines
     ("profile_dir", "str", "", ("trace_dir",)),
+    # compiled-HLO cost accounting (observability/costmodel.py): harvest
+    # flops/bytes from every hot jitted entry and report measured
+    # per-phase MFU + roofline classification in iteration events and
+    # serving stats (active during metrics runs and daemon lifetimes)
+    ("roofline", "bool", True, ("cost_analysis", "measured_mfu")),
+    # bound of the always-on flight recorder's per-iteration ring
+    # (observability/flightrec.py); the serve-trace ring is fixed
+    ("flight_recorder_size", "int", 256, ("flight_recorder_capacity",)),
+    # Prometheus GET /metrics listener (observability/prom.py):
+    # -1 = off, 0 = ephemeral (logged), >0 = fixed port.  Served by
+    # both the serving daemon and metrics-dir training runs
+    ("metrics_port", "int", -1, ("prometheus_port",)),
     # --- host-boundary performance (docs/Performance.md) ---
     # persistent XLA compilation cache: repeat runs of the same config
     # skip the multi-minute ladder compile (cache-hit/miss counters land
@@ -303,6 +315,10 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # bound on the SIGTERM drain: queued requests older than this are
     # failed so a preemption notice cannot stall the exit indefinitely
     ("serve_drain_timeout_s", "float", 10.0, ()),
+    # flight-recorder request tracing: every Nth served request records
+    # its enqueue->coalesce->dispatch->device-settle->respond stage
+    # timestamps into the bounded trace ring (0 = off)
+    ("serve_trace_sample", "int", 64, ("trace_sample",)),
     ("start_iteration_predict", "int", 0, ()),
     ("num_iteration_predict", "int", -1, ()),
     ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),

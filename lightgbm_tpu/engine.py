@@ -171,6 +171,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     profile_dir = cfg.profile_dir or None
     event_logger = None
     timer_was_enabled = global_timer.enabled
+    cost_was_enabled = None
+    metrics_srv = None
     if metrics_dir:
         from .observability import EventLogger, set_event_logger
         event_logger = EventLogger(metrics_dir,
@@ -180,6 +182,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # the per-iteration phase breakdown diffs global_timer snapshots;
         # a metrics run therefore always times (restored afterwards)
         global_timer.enabled = True
+        if cfg.roofline:
+            # compiled-cost accounting: per-phase measured MFU +
+            # roofline classification in the iteration events
+            # (observability/costmodel.py; restored afterwards)
+            from .observability import enable_cost_model
+            cost_was_enabled = enable_cost_model(True)
+        # flight recorder bound + SIGUSR2 on-demand dump: `kill -USR2`
+        # writes <metrics_dir>/flight-rank<r>.json from the live run
+        from .observability import process_rank as _prank
+        from .observability.flightrec import flight_recorder
+        from .reliability.faults import register_flight_dump_signal
+        flight_recorder.resize(cfg.flight_recorder_size)
+        register_flight_dump_signal(metrics_dir, rank=_prank())
         event_logger.emit("train_start", num_boost_round=num_boost_round,
                           params=cfg.changed_params())
         if degrade_info["new"]:
@@ -189,6 +204,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                               active=degrade_info["applied"],
                               stall_iteration=(degrade_info["stall"] or {})
                               .get("last_iteration"))
+    if cfg.metrics_port >= 0:
+        # the trainer exports the same registry snapshot the serving
+        # daemon scrapes: counters, gauges, cost totals — GET /metrics
+        # (observability/prom.py), shut down with the run
+        from .observability import start_metrics_http
+        metrics_srv = start_metrics_http(cfg.metrics_port)
     profiling = False
     if profile_dir:
         try:
@@ -380,6 +401,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         cb(env)
                     if run_guard is not None:
                         run_guard.tick(i + 1)
+                        if event_logger is None:
+                            # guarded-but-unmetered runs (supervisor
+                            # heartbeat dir, no metrics_dir) still leave
+                            # a minimal trail for the stall diagnosis's
+                            # flight tail; metrics runs get the rich
+                            # record from record_metrics instead
+                            from .observability.flightrec import \
+                                flight_recorder
+                            flight_recorder.record_iteration(
+                                iteration=i + 1)
             except EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 for name, metric, value, _ in e.best_score:
@@ -427,12 +458,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 counters=global_registry.snapshot()["counters"])
         return booster
     finally:
+        import sys as _sys
+        if _sys.exc_info()[0] is not None and (metrics_dir or guard_dir):
+            # crashing: dump the flight recorder synchronously next to
+            # the logs so the supervisor's crash classification can
+            # surface what the rank was doing (flight-rank<r>.json)
+            from .observability import process_rank as _prank
+            from .observability.flightrec import dump_flight_record
+            dump_flight_record(metrics_dir or guard_dir, rank=_prank(),
+                               reason="crash")
         if ckpt_mgr is not None and cfg.preempt_ckpt_grace_s > 0:
             from .observability import clear_preemption_hook
             clear_preemption_hook()
         if run_guard is not None:
             run_guard.stop()
         global_timer.enabled = timer_was_enabled
+        if cost_was_enabled is not None:
+            from .observability import enable_cost_model
+            enable_cost_model(cost_was_enabled)
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
         if profiling:
             try:
                 import jax

@@ -168,7 +168,16 @@ class DevicePredictor:
 
     # ------------------------------------------------------------ predict
     def _run(self, X: np.ndarray, mode: str,
-             early_stop: Optional[Tuple[int, float]] = None):
+             early_stop: Optional[Tuple[int, float]] = None,
+             account: bool = True):
+        """One padded-bucket dispatch.  With the cost model enabled and
+        `account` (false for warmup compiles), the dispatch's compiled
+        flops/bytes and wall seconds accumulate into the registry
+        (`device_predict_flops` / `_bytes` / `_s`) — flop and second
+        measured at the SAME site, so the serving roofline never mixes
+        warmup work into serving time."""
+        import time as _time
+
         import jax
         X = np.ascontiguousarray(X, np.float32)
         if X.ndim == 1:
@@ -194,6 +203,9 @@ class DevicePredictor:
         else:
             xp = X
         xd = jax.device_put(xp, self._x_sharding)
+        from ..observability.costmodel import global_cost_model
+        t0 = (_time.perf_counter()
+              if account and global_cost_model.enabled else None)
         with warnings.catch_warnings():
             # CPU XLA cannot alias the donated [bucket, F] input into the
             # differently-shaped output and warns at compile; on TPU the
@@ -201,12 +213,26 @@ class DevicePredictor:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             with global_timer.scope("DevicePredictor::dispatch"):
-                out = self._fn_for(mode, bucket, F, es_freq)(
-                    xd, *extra, *self._device_arrays())
+                fn = self._fn_for(mode, bucket, F, es_freq)
+                out = fn(xd, *extra, *self._device_arrays())
                 # when timing, settle here so dispatch vs device time
                 # split into ::dispatch / ::dispatch::device scopes
                 out = global_timer.block(out)
-        return np.asarray(out)[:n], bucket
+        host = np.asarray(out)
+        if t0 is not None:
+            # host materialization above settled the device, so the
+            # elapsed wall covers pad + H2D + program + D2H of exactly
+            # this dispatch; the per-call cost is the harvested compiled
+            # analysis of the bucket entry just invoked
+            dt = _time.perf_counter() - t0
+            cost = global_cost_model.per_call(fn._name)
+            from ..observability.registry import global_registry
+            if cost is not None:
+                global_registry.inc("device_predict_flops", cost[0])
+                global_registry.inc("device_predict_bytes", cost[1])
+            global_registry.inc("device_predict_s", dt)
+            global_registry.inc("device_predict_dispatches")
+        return host[:n], bucket
 
     def warmup(self, num_features: int, max_rows: int,
                modes=("convert", "raw"),
@@ -222,7 +248,9 @@ class DevicePredictor:
         while True:
             x = np.zeros((b, num_features), np.float32)
             for mode in modes:
-                self._run(x, mode, early_stop=early_stop)
+                # account=False: warmup compiles must not pollute the
+                # serving roofline's flop/second ledger
+                self._run(x, mode, early_stop=early_stop, account=False)
             if b >= max_rows:
                 break
             b *= 2

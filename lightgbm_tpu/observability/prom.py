@@ -1,0 +1,191 @@
+"""Prometheus text-format exposition of the metrics registry
+(docs/Observability.md): the scrape surface the fleet/router/canary
+layer needs.
+
+The serving daemon's stats were a poll-only JSON op — fine for a human
+with `nc`, useless for a router that wants to load-balance on queue
+depth or a canary controller watching p99 drift across replicas.  This
+module renders the process-wide registry (counters, gauges), the
+serving daemon's latency window and per-model state, and the cost
+model's roofline aggregates in the Prometheus text format (version
+0.0.4: `# TYPE` lines + `name{label="v"} value`), and serves it two
+ways:
+
+* `GET /metrics` on a tiny threaded HTTP listener (`start_metrics_http`,
+  param `metrics_port`: -1 off, 0 ephemeral, >0 fixed) — what a
+  Prometheus scraper, k8s probe, or fleet router actually pulls;
+* `op=metrics` on the line-JSON TCP front end (frontend.py) — the same
+  text inline, for clients already on that wire.
+
+Everything renders from one `snapshot()` read, so a scrape costs two
+dict copies and string formatting — no device interaction, no locks
+held across I/O.  Counters whose registry name carries a `::label`
+suffix (e.g. `serve_requests_by_model::higgs`, maintained by the
+coalescer) render as labelled series:
+`lgbm_serve_requests_by_model{model="higgs"}`.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import log
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str, prefix: str) -> str:
+    return prefix + _NAME_OK.sub("_", name)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "NaN"
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(registry=None, daemon=None, prefix: str = "lgbm_",
+                      extra_gauges: Optional[Dict[str, float]] = None
+                      ) -> str:
+    """One Prometheus text page: registry counters/gauges (+ labelled
+    `name::label` series), serving latency quantiles / queue depth /
+    per-model state when a daemon is given, roofline aggregates when
+    the cost model is enabled, and any `extra_gauges`."""
+    if registry is None:
+        from .registry import global_registry
+        registry = global_registry
+    snap = registry.snapshot()
+    lines: List[str] = []
+
+    def emit_family(kind: str, base: str,
+                    series: List[tuple]) -> None:
+        # series: [(labels_dict_or_None, value), ...]
+        lines.append(f"# TYPE {base} {kind}")
+        for labels, value in series:
+            if labels:
+                lab = ",".join(f'{k}="{_escape_label(v)}"'
+                               for k, v in sorted(labels.items()))
+                lines.append(f"{base}{{{lab}}} {_fmt(value)}")
+            else:
+                lines.append(f"{base} {_fmt(value)}")
+
+    # registry counters: plain names become one series; `name::label`
+    # names fold into one labelled family per base name
+    for kind, table in (("counter", snap["counters"]),
+                        ("gauge", snap["gauges"])):
+        families: Dict[str, List[tuple]] = {}
+        for name in sorted(table):
+            base, sep, label = name.partition("::")
+            key = _metric_name(base, prefix)
+            families.setdefault(key, []).append(
+                ({"model": label} if sep else None, table[name]))
+        for base, series in families.items():
+            emit_family(kind, base, series)
+
+    if daemon is not None:
+        try:
+            p50, p99 = daemon.latency.percentiles((50.0, 99.0))
+            emit_family("gauge", f"{prefix}serve_latency_ms",
+                        [({"quantile": "0.5"}, p50),
+                         ({"quantile": "0.99"}, p99)])
+            emit_family("gauge", f"{prefix}serve_queue_pending",
+                        [(None, daemon.coalescer.pending)])
+            rstats = daemon.registry.stats()
+            emit_family("gauge", f"{prefix}serve_recompiles",
+                        [(None, rstats.get("serve_recompiles", 0))])
+            models = rstats.get("models", {})
+            for field in ("version", "in_flight"):
+                emit_family(
+                    "gauge", f"{prefix}serve_model_{field}",
+                    [({"model": n}, m.get(field))
+                     for n, m in sorted(models.items())] or [(None, 0)])
+        except Exception as e:  # noqa: BLE001 - a scrape must never kill serving
+            log.warning(f"/metrics: daemon stats unavailable: {e}")
+
+    from .costmodel import global_cost_model
+    if global_cost_model.enabled:
+        cm = global_cost_model.snapshot()
+        for field, kind in (("flops", "counter"), ("bytes", "counter"),
+                            ("calls", "counter")):
+            series = [({"phase": g}, tot[field])
+                      for g, tot in sorted(cm.items())]
+            if series:
+                emit_family(kind, f"{prefix}cost_{field}_total", series)
+
+    for name, value in sorted((extra_gauges or {}).items()):
+        emit_family("gauge", _metric_name(name, prefix), [(None, value)])
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsServer:
+    """Tiny threaded HTTP listener exposing `GET /metrics`."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+
+    @property
+    def port(self) -> int:
+        return int(self._server.server_address[1])
+
+    def shutdown(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+
+
+def start_metrics_http(port: int = 0, host: str = "127.0.0.1",
+                       daemon=None, registry=None,
+                       prefix: str = "lgbm_") -> Optional[_MetricsServer]:
+    """Bind `GET /metrics` (port 0 = ephemeral; read `server.port`) and
+    serve on a background thread.  Returns None (with a warning) when
+    the bind fails — a metrics port conflict must never block serving
+    or training."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?", 1)[0] != "/metrics":
+                self.send_error(404, "try /metrics")
+                return
+            try:
+                body = render_prometheus(registry=registry, daemon=daemon,
+                                         prefix=prefix).encode()
+            except Exception as e:  # noqa: BLE001 - scrape must answer, not raise
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # route through utils.log
+            log.debug(f"/metrics: {fmt % args}")
+
+    try:
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+    except OSError as e:
+        log.warning(f"Could not bind the metrics listener on "
+                    f"{host}:{port}: {e}")
+        return None
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="lgbm-metrics-http", daemon=True)
+    t.start()
+    log.info(f"Prometheus /metrics listening on "
+             f"{srv.server_address[0]}:{srv.server_address[1]}")
+    return _MetricsServer(srv, t)

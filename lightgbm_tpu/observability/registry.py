@@ -57,6 +57,20 @@ class MetricsRegistry:
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges)}
 
+    def snapshot_nolock(self) -> Dict[str, Dict[str, Number]]:
+        """Signal-path snapshot: the SIGUSR2 flight dump and the stall
+        watchdog's exit read through here, where taking `_lock` could
+        deadlock on the very thread the handler interrupted.  A dict
+        copy racing a writer can raise RuntimeError; retry, then settle
+        for empty — a partial snapshot beats a wedged handler."""
+        for _ in range(4):
+            try:
+                return {"counters": dict(self._counters),
+                        "gauges": dict(self._gauges)}
+            except RuntimeError:
+                continue
+        return {"counters": {}, "gauges": {}}
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()

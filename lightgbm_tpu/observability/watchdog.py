@@ -20,6 +20,7 @@ import functools
 from typing import Dict
 
 from ..utils import log
+from .costmodel import global_cost_model
 from .events import emit_event
 from .registry import global_registry
 
@@ -65,6 +66,13 @@ class RecompileDetector:
                 emit_event("recompile", fn=self._name,
                            signature=[list(s) for s in sig[0]])
             self._seen.add(sig)
+        if global_cost_model.enabled:
+            # compiled-cost accounting (costmodel.py): keyed by the SAME
+            # signature this watchdog fingerprints, so the flop/byte
+            # ledger can never disagree about which executable ran; the
+            # harvest itself uses .lower() (no compile, no new trace)
+            global_cost_model.observe(self._name, sig, self._fn,
+                                      args, kwargs)
         return self._fn(*args, **kwargs)
 
     @property

@@ -222,8 +222,13 @@ class DeviceEval:
             outs.append(jnp.all(jnp.isfinite(scores)).astype(jnp.float32))
             return jnp.stack(outs)
 
+        # recompile watchdog + compiled-cost roofline accounting: the
+        # packed eval tick is a hot jitted entry like grow/gradients —
+        # a mid-run shape change must warn, and the cost model wants
+        # its flops/bytes keyed by the same signature
+        from ..observability import RecompileDetector
         # tpulint: disable-next=donate-argnums -- eval reads the live training score buffer; the boosting loop keeps updating it
-        self._fn = jax.jit(_tick)
+        self._fn = RecompileDetector(jax.jit(_tick), "device_eval")
         self._pad_mask = gbdt.pad_mask
         self._true_flag = jnp.asarray(True)
         self.ok = True

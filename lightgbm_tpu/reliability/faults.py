@@ -197,6 +197,37 @@ def register_stack_dump_signal() -> bool:
         return False
 
 
+def register_flight_dump_signal(directory: str,
+                                rank: Optional[int] = None) -> bool:
+    """SIGUSR1's sibling: `kill -USR2 <pid>` dumps the flight recorder
+    plus a registry snapshot to `<directory>/flight-rank<r>.json`
+    WITHOUT killing the process — recent iteration history, sampled
+    serving traces and counters from a live (possibly misbehaving)
+    worker, where SIGUSR1 only gives stacks.  The dump rides the
+    signal-safe synchronous write path (flightrec.dump_flight_record:
+    lock-free reads, atomic write, no AsyncWriter, no jax — the PR-9
+    terminal-event rule; the rank is resolved HERE, at registration,
+    because resolving it queries the jax runtime, which a handler on a
+    wedged process must never touch).  Returns False where
+    unsupported."""
+    directory = os.fspath(directory)
+    if rank is None:
+        from ..observability.registry import process_rank
+        rank = process_rank()
+
+    def _handler(signum, frame):
+        from ..observability.flightrec import dump_flight_record
+        dump_flight_record(directory, rank=rank, reason="sigusr2")
+
+    try:
+        import signal
+        signal.signal(signal.SIGUSR2, _handler)
+        return True
+    except (AttributeError, ImportError, ValueError, OSError,
+            RuntimeError):
+        return False  # non-main thread / no SIGUSR2 on this platform
+
+
 def maybe_ckpt_write_fail(iteration: int) -> None:
     """ckpt_write_fail hook, called before the checkpoint touches disk."""
     if _should_fire("ckpt_write_fail", iteration):

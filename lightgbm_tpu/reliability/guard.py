@@ -294,6 +294,7 @@ class RunGuard:
     def build_diagnosis(self, silent_s: float,
                         deadline_s: float) -> Dict[str, Any]:
         from ..observability.events import get_event_logger
+        from ..observability.flightrec import flight_recorder
         last_event = None
         lg = get_event_logger()
         if lg is not None:
@@ -316,6 +317,10 @@ class RunGuard:
             "median_iter_s": round(med, 6) if med is not None else None,
             "knobs": dict(self.knobs),
             "last_event": last_event,
+            # what the run was DOING just before it went silent: the
+            # flight recorder's newest iteration records (lock-free
+            # read — this thread is diagnosing a wedged process)
+            "flight": flight_recorder.tail(32),
             "jax": _jax_snapshot(),
             "stacks": _dump_all_stacks(),
             "exit_code": STALL_EXIT_CODE,
@@ -323,7 +328,14 @@ class RunGuard:
 
     def write_diagnosis(self, diagnosis: Dict[str, Any]) -> Optional[str]:
         """Atomic, SYNCHRONOUS write — never through the AsyncWriter,
-        whose thread may be part of what is hung."""
+        whose thread may be part of what is hung.  The full flight
+        record lands next to it (flight-rank<r>.json) through the same
+        sync path, so the supervisor can surface both tails."""
+        try:
+            from ..observability.flightrec import dump_flight_record
+            dump_flight_record(self.dir, rank=self.rank, reason="stall")
+        except Exception:  # noqa: BLE001 - diagnosis must not throw
+            pass
         path = stall_file_path(self.dir, self.rank)
         try:
             atomic_write_text(path, json.dumps(diagnosis, indent=1,

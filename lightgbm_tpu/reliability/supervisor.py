@@ -41,6 +41,7 @@ class WorkerFailure:
     log_tail: str
     kind: str = "crash"  # "crash" | "hang" | "timeout"
     stall_tail: str = ""  # tail of stall-rank<r>.json when one exists
+    flight_tail: str = ""  # tail of flight-rank<r>.json when one exists
 
 
 @dataclass
@@ -85,6 +86,10 @@ class SuperviseResult:
             if f.stall_tail:
                 parts.append(f"rank {f.rank} stall diagnosis "
                              f"(stall-rank{f.rank}.json):\n{f.stall_tail}")
+            if f.flight_tail:
+                parts.append(f"rank {f.rank} flight record "
+                             f"(flight-rank{f.rank}.json):\n"
+                             f"{f.flight_tail}")
         return "\n".join(parts)
 
 
@@ -106,6 +111,19 @@ def _stall_tail(stall_dir: Optional[str], rank: int) -> str:
     if not stall_dir:
         return ""
     path = stall_file_path(stall_dir, rank)
+    if not os.path.exists(path):
+        return ""
+    return tail_file(path, max_bytes=2048)
+
+
+def _flight_tail(stall_dir: Optional[str], rank: int) -> str:
+    """Tail of rank's flight record — the guard dumps one on a stall
+    and the engine dumps one on a crash, so a classified failure
+    carries what the rank was DOING, not just where it died."""
+    if not stall_dir:
+        return ""
+    from ..observability.flightrec import flight_file_path
+    path = flight_file_path(stall_dir, rank)
     if not os.path.exists(path):
         return ""
     return tail_file(path, max_bytes=2048)
@@ -182,18 +200,21 @@ def supervise(procs, log_paths: List[str], timeout: float,
     failures = [
         WorkerFailure(r, procs[r].returncode, tail_file(log_paths[r]),
                       kind=classify_returncode(procs[r].returncode),
-                      stall_tail=_stall_tail(stall_dir, r))
+                      stall_tail=_stall_tail(stall_dir, r),
+                      flight_tail=_flight_tail(stall_dir, r))
         for r in failed]
     for r in stalled:
         # killed by US for heartbeat staleness: the returncode is the
         # kill signal, which classify_returncode would miscall "crash"
         failures.append(WorkerFailure(
             r, None, tail_file(log_paths[r]), kind="hang",
-            stall_tail=_stall_tail(stall_dir, r)))
+            stall_tail=_stall_tail(stall_dir, r),
+            flight_tail=_flight_tail(stall_dir, r)))
     if timed_out:
         failures.extend(
             WorkerFailure(r, None, tail_file(log_paths[r]), kind="timeout",
-                          stall_tail=_stall_tail(stall_dir, r))
+                          stall_tail=_stall_tail(stall_dir, r),
+                          flight_tail=_flight_tail(stall_dir, r))
             for r in sorted(pending))
     ok = not failures and not timed_out
     return SuperviseResult(ok=ok, timed_out=timed_out, failures=failures)

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.flightrec import flight_recorder
 from ..observability.registry import LatencyWindow, global_registry
 from ..utils import log
 from ..utils.timer import global_timer
@@ -81,7 +82,7 @@ class ServeFuture:
 
 class ServeRequest:
     __slots__ = ("entry", "X", "mode", "n", "future", "t_submit",
-                 "early_stop")
+                 "early_stop", "t_coalesce")
 
     def __init__(self, entry, X: np.ndarray, mode: str,
                  early_stop: Optional[Tuple[int, float]] = None):
@@ -92,6 +93,9 @@ class ServeRequest:
         self.n = int(X.shape[0])
         self.future = ServeFuture()
         self.t_submit = time.monotonic()
+        # stamped by the dispatcher when the request leaves the queue;
+        # the flight recorder's stage breakdown reads it
+        self.t_coalesce: Optional[float] = None
 
 
 class Coalescer:
@@ -100,11 +104,18 @@ class Coalescer:
 
     def __init__(self, max_wait_ms: float = 2.0, queue_depth: int = 1024,
                  max_batch_rows: int = 65536,
-                 latency_window: Optional[LatencyWindow] = None):
+                 latency_window: Optional[LatencyWindow] = None,
+                 trace_sample: int = 0):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_depth), 1))
         self._max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
         self._max_rows = max(int(max_batch_rows), 1)
         self._window = latency_window
+        # flight-recorder request tracing: every `trace_sample`-th
+        # request gets a full enqueue->coalesce->dispatch->settle->
+        # respond stage record (0 = off); only touched by the dispatcher
+        # thread, so a plain counter suffices
+        self._trace_sample = max(int(trace_sample), 0)
+        self._req_seq = 0
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._closing = False
@@ -191,6 +202,7 @@ class Coalescer:
                 if self._stop.is_set():
                     return
                 continue
+            first.t_coalesce = time.monotonic()
             batch = [first]
             rows = first.n
             if self._max_wait_s > 0 and not self._stop.is_set():
@@ -203,6 +215,7 @@ class Coalescer:
                         nxt = self._q.get(timeout=rem)
                     except queue.Empty:
                         break
+                    nxt.t_coalesce = time.monotonic()
                     batch.append(nxt)
                     rows += nxt.n
             else:
@@ -211,6 +224,7 @@ class Coalescer:
                         nxt = self._q.get_nowait()
                     except queue.Empty:
                         break
+                    nxt.t_coalesce = time.monotonic()
                     batch.append(nxt)
                     rows += nxt.n
             try:
@@ -229,6 +243,10 @@ class Coalescer:
             key = (id(req.entry), req.mode, req.X.shape[1], req.early_stop)
             groups.setdefault(key, []).append(req)
         global_registry.inc("serve_batches")
+        # coalesce-shape telemetry: the batch-size histogram says what
+        # the wait-knob trade actually bought (flight recorder + dump)
+        flight_recorder.record_batch(len(batch),
+                                     sum(r.n for r in batch))
         for reqs in groups.values():
             self._dispatch_group(reqs)
 
@@ -237,6 +255,7 @@ class Coalescer:
         mode = reqs[0].mode
         dp = entry.predictor
         try:
+            t_dispatch = time.monotonic()
             with global_timer.scope("Serve::dispatch"):
                 X = (np.concatenate([r.X for r in reqs], axis=0)
                      if len(reqs) > 1 else reqs[0].X)
@@ -246,18 +265,35 @@ class Coalescer:
                     out = dp.predict_raw(X, early_stop=reqs[0].early_stop)
                 else:
                     out = dp.predict(X, early_stop=reqs[0].early_stop)
-            now = time.monotonic()
+            # the predictor returned a host ndarray, so the device has
+            # settled by here: t_settle - t_dispatch covers pad + H2D +
+            # program + D2H for the whole fused group
+            t_settle = time.monotonic()
             off = 0
             for r in reqs:
-                lat = (now - r.t_submit) * 1000.0
+                lat = (t_settle - r.t_submit) * 1000.0
                 r.future._set(result=out[off:off + r.n],
                               version=entry.version, latency_ms=lat)
                 off += r.n
                 if self._window is not None:
                     self._window.record(lat)
+                self._req_seq += 1
+                if self._trace_sample and \
+                        self._req_seq % self._trace_sample == 0:
+                    self._record_trace(r, entry, mode, len(reqs),
+                                       t_dispatch, t_settle)
             global_registry.inc("serve_requests", len(reqs))
             global_registry.inc("serve_rows", int(off))
             global_registry.inc("serve_dispatches")
+            # per-model serve counts + dispatch seconds: the Prometheus
+            # page renders the `::name` suffix as a {model=...} label,
+            # and the serving roofline divides the cost model's totals
+            # by the accumulated dispatch seconds
+            global_registry.inc(f"serve_requests_by_model::{entry.name}",
+                                len(reqs))
+            global_registry.inc(f"serve_rows_by_model::{entry.name}",
+                                int(off))
+            global_registry.inc("serve_dispatch_s", t_settle - t_dispatch)
         except Exception as e:  # noqa: BLE001 - a bad request must not kill the thread
             log.warning(f"Serving dispatch failed for model "
                         f"{entry.name!r} v{entry.version}: {e}")
@@ -267,3 +303,21 @@ class Coalescer:
         finally:
             for r in reqs:
                 r.entry.release()
+
+    @staticmethod
+    def _record_trace(r: ServeRequest, entry, mode: str,
+                      group_requests: int, t_dispatch: float,
+                      t_settle: float) -> None:
+        """One sampled request's stage breakdown into the flight
+        recorder: all stamps as ms offsets from enqueue, so a dumped
+        trace reads as a waterfall without clock context."""
+        t0 = r.t_submit
+        ms = lambda t: (round((t - t0) * 1000.0, 3)
+                        if t is not None else None)
+        t_respond = time.monotonic()
+        flight_recorder.record_trace(
+            trace_id=flight_recorder.next_trace_id(),
+            model=entry.name, version=entry.version, mode=mode,
+            rows=r.n, group_requests=group_requests,
+            coalesce_ms=ms(r.t_coalesce), dispatch_ms=ms(t_dispatch),
+            device_settle_ms=ms(t_settle), respond_ms=ms(t_respond))

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..config import Config
 from ..observability import emit_event
+from ..observability.costmodel import global_cost_model
 from ..observability.registry import LatencyWindow, global_registry
 from ..utils import log
 from .coalescer import Coalescer, ServeFuture, ServeRequest
@@ -95,17 +96,34 @@ class ServingDaemon:
             max_wait_ms=config.serve_max_coalesce_wait_ms,
             queue_depth=config.serve_queue_depth,
             max_batch_rows=config.serve_max_batch_rows,
-            latency_window=self.latency)
+            latency_window=self.latency,
+            trace_sample=config.serve_trace_sample)
         self._stopped = threading.Event()
+        self.metrics_server = None
+        # compiled-cost roofline accounting (costmodel.py): enabled for
+        # the daemon's lifetime so stats()/`/metrics` carry measured MFU
+        # per dispatch; the harvest uses .lower() only, so the
+        # serve_recompiles == 0 invariant is untouched
+        self._prev_cost_enabled = global_cost_model.enabled
+        if config.roofline:
+            global_cost_model.enabled = True
 
     # -------------------------------------------------------------- control
     def start(self) -> "ServingDaemon":
         self.coalescer.start()
+        if self.config.metrics_port >= 0 and self.metrics_server is None:
+            # fleet scrape surface (observability/prom.py): routers,
+            # canary controllers and Prometheus pull GET /metrics here
+            from ..observability import start_metrics_http
+            self.metrics_server = start_metrics_http(
+                port=self.config.metrics_port, daemon=self)
         emit_event("serve_start", pid=os.getpid(),
                    max_coalesce_wait_ms=self.config
                    .serve_max_coalesce_wait_ms,
                    queue_depth=self.config.serve_queue_depth,
-                   max_batch_rows=self.config.serve_max_batch_rows)
+                   max_batch_rows=self.config.serve_max_batch_rows,
+                   metrics_port=(self.metrics_server.port
+                                 if self.metrics_server else None))
         return self
 
     def stop(self, drain: bool = True,
@@ -116,6 +134,10 @@ class ServingDaemon:
             return True
         drained = self.coalescer.stop(drain=drain, timeout=timeout)
         self.registry.close()
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()
+            self.metrics_server = None
+        global_cost_model.enabled = self._prev_cost_enabled
         self._stopped.set()
         emit_event("serve_stop", drained=drained,
                    requests=int(global_registry.counter("serve_requests")))
@@ -195,6 +217,32 @@ class ServingDaemon:
             "queue_pending": self.coalescer.pending,
         }
         out.update(self.registry.stats())
+        rl = self.roofline_stats()
+        if rl is not None:
+            out["roofline"] = rl
+        return out
+
+    def roofline_stats(self) -> Optional[Dict[str, object]]:
+        """Measured serving roofline (docs/Observability.md): compiled
+        flops/bytes and wall seconds accumulated AT THE DISPATCH SITE
+        (DevicePredictor._run, warmup excluded), so the MFU numerator
+        and denominator describe the same work.  None when the cost
+        model is off or nothing dispatched yet."""
+        if not global_cost_model.enabled:
+            return None
+        flops = float(global_registry.counter("device_predict_flops"))
+        bytes_accessed = float(
+            global_registry.counter("device_predict_bytes"))
+        seconds = float(global_registry.counter("device_predict_s"))
+        dispatches = int(
+            global_registry.counter("device_predict_dispatches"))
+        if dispatches <= 0:
+            return None
+        from ..observability.costmodel import roofline
+        out = roofline(flops, bytes_accessed, seconds)
+        out["dispatch_s"] = round(seconds, 6)
+        out["dispatches"] = dispatches
+        out["measured_mfu"] = out.pop("mfu")
         return out
 
 
@@ -223,9 +271,10 @@ class ServingClient:
 
 def serve_counters_reset() -> None:
     """Zero the serve_* counters (tests and the bench isolate phases);
-    the registry is process-global, so only the serving keys reset."""
-    for key in ("serve_requests", "serve_rows", "serve_batches",
-                "serve_dispatches", "serve_errors", "serve_swaps",
-                "serve_load_failures"):
-        global_registry.inc(key, -global_registry.counter(key))
+    the registry is process-global, so only the serving keys reset —
+    including the per-model `serve_*_by_model::<name>` series and the
+    dispatch-seconds accumulator."""
+    for key in list(global_registry.snapshot()["counters"]):
+        if key.startswith("serve_"):
+            global_registry.inc(key, -global_registry.counter(key))
     log.debug("serve counters reset")

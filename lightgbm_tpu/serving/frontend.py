@@ -7,6 +7,7 @@ analogue is file-in/file-out prediction; a daemon needs a wire):
       -> {"ok": true, "version": 2, "preds": [...]}
     {"op": "stats"}      -> {"ok": true, "stats": {...}}
     {"op": "models"}     -> {"ok": true, "models": [...]}
+    {"op": "metrics"}    -> {"ok": true, "metrics": "<prometheus text>"}
 
 Deliberately minimal: newline-framed JSON over TCP is debuggable with
 `nc`, needs no dependency, and each connection gets its own handler
@@ -47,6 +48,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 if op == "models":
                     self._reply({"ok": True,
                                  "models": daemon.registry.names()})
+                    continue
+                if op == "metrics":
+                    # the Prometheus text page inline, for clients
+                    # already on this wire (the HTTP listener on
+                    # `metrics_port` is the scraper-facing surface)
+                    from ..observability import render_prometheus
+                    self._reply({"ok": True,
+                                 "metrics": render_prometheus(
+                                     daemon=daemon)})
                     continue
                 rows = np.asarray(msg["rows"], np.float64)
                 fut = daemon.submit(msg.get("model", "default"), rows,

@@ -130,6 +130,7 @@ def test_async_event_log_matches_sync(tmp_path):
             rec.pop("ts", None)
             rec.pop("phases", None)          # wall-clock dependent
             rec.pop("time_s", None)
+            rec.pop("roofline", None)        # mfu = flops / wall-clock
             (rec.get("params") or {}).pop("async_host_io", None)
             if rec["event"].startswith("checkpoint"):
                 rec["path"] = os.path.basename(rec.get("path", ""))

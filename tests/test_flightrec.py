@@ -1,0 +1,141 @@
+"""Flight recorder (observability/flightrec.py): ring bounds, batch
+histograms, the synchronous dump artifact, the SIGUSR2 on-demand dump,
+and the stall diagnosis embedding the iteration tail."""
+
+import json
+import os
+import signal
+
+import numpy as np
+
+from lightgbm_tpu.observability.flightrec import (FlightRecorder,
+                                                  dump_flight_record,
+                                                  flight_file_path,
+                                                  flight_recorder)
+
+
+def test_iteration_ring_is_bounded_and_keeps_newest():
+    fr = FlightRecorder(capacity=16)
+    for i in range(50):
+        fr.record_iteration(iteration=i)
+    tail = fr.tail(99)
+    assert len(tail) == 16
+    assert [r["iteration"] for r in tail] == list(range(34, 50))
+    assert all("ts" in r for r in tail)
+
+
+def test_resize_keeps_newest_records():
+    fr = FlightRecorder(capacity=64)
+    for i in range(40):
+        fr.record_iteration(iteration=i)
+    fr.resize(10)
+    assert [r["iteration"] for r in fr.tail(99)] == list(range(30, 40))
+    fr.resize(3)  # floored to 8
+    assert len(fr.tail(99)) == 8
+
+
+def test_batch_histogram_buckets_are_log2():
+    fr = FlightRecorder()
+    for n in (1, 2, 3, 4, 1 << 20):
+        fr.record_batch(num_requests=n, num_rows=n * 4)
+    hist = fr.contents()["coalesce_batch_requests_hist"]
+    assert hist[0] == 1          # n=1 -> bucket 0
+    assert hist[1] == 2          # n=2,3 -> bucket 1
+    assert hist[2] == 1          # n=4 -> bucket 2
+    assert hist[-1] == 1         # open-ended top bucket
+    assert sum(hist) == 5
+
+
+def test_trace_ring_and_ids():
+    fr = FlightRecorder(trace_capacity=8)
+    ids = [fr.next_trace_id() for _ in range(3)]
+    assert ids == [0, 1, 2]
+    for i in range(20):
+        fr.record_trace(trace_id=i, rows=4)
+    assert [t["trace_id"] for t in fr.trace_tail(99)] == list(range(12, 20))
+
+
+def test_dump_writes_parseable_artifact(tmp_path):
+    fr_path = dump_flight_record(str(tmp_path), rank=3, reason="unit")
+    assert fr_path == flight_file_path(str(tmp_path), 3)
+    payload = json.load(open(fr_path))
+    assert payload["kind"] == "flight_record"
+    assert payload["reason"] == "unit" and payload["rank"] == 3
+    for key in ("iterations", "serve_traces",
+                "coalesce_batch_requests_hist", "registry"):
+        assert key in payload
+    assert "counters" in payload["registry"]
+
+
+def test_sigusr2_dumps_without_killing_process(tmp_path):
+    """The satellite contract: `kill -USR2` on a live process writes
+    flight-rank<r>.json through the signal-safe path and the process
+    carries on."""
+    from lightgbm_tpu.reliability.faults import register_flight_dump_signal
+    flight_recorder.record_iteration(iteration=123, marker="sigusr2-test")
+    assert register_flight_dump_signal(str(tmp_path), rank=0)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        path = flight_file_path(str(tmp_path), 0)
+        assert os.path.exists(path)
+        payload = json.load(open(path))
+        assert payload["reason"] == "sigusr2"
+        assert any(r.get("marker") == "sigusr2-test"
+                   for r in payload["iterations"])
+    finally:
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+def test_stall_diagnosis_embeds_flight_tail_and_dumps_file(tmp_path):
+    """RunGuard wiring: a tripped watchdog's diagnosis carries the
+    recorder's iteration tail under `flight`, and the full flight
+    record lands next to stall-rank<r>.json."""
+    import time
+
+    from lightgbm_tpu.reliability.guard import RunGuard
+
+    flight_recorder.record_iteration(iteration=77, marker="pre-stall")
+    hits = []
+    g = RunGuard(str(tmp_path), rank=0, stall_floor_s=0.1,
+                 stall_factor=1.0, first_deadline_s=0.2,
+                 on_stall=hits.append, poll_interval=0.05)
+    g.start()
+    deadline = time.monotonic() + 10.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.02)
+    g.stop()
+    assert hits, "watchdog never tripped"
+    diag = hits[0]
+    assert any(r.get("marker") == "pre-stall" for r in diag["flight"])
+    fpath = flight_file_path(str(tmp_path), 0)
+    assert os.path.exists(fpath)
+    assert json.load(open(fpath))["reason"] == "stall"
+
+
+def test_crash_dump_lands_next_to_event_log(tmp_path):
+    """engine.train's unwind dumps the flight record when a metrics run
+    dies, so the supervisor's failure report can surface it."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(5)
+    X = rng.rand(200, 4)
+    y = X[:, 0].astype(np.float64)
+    d = str(tmp_path / "metrics")
+
+    def boom(env):
+        if env.iteration >= 1:
+            raise RuntimeError("injected crash")
+
+    try:
+        lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1, "min_data_in_leaf": 5,
+                   "metrics_dir": d},
+                  lgb.Dataset(X, label=y), num_boost_round=5,
+                  callbacks=[boom])
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("injected crash did not propagate")
+    payload = json.load(open(flight_file_path(d, 0)))
+    assert payload["reason"] == "crash"
+    assert any(r.get("iteration") == 1 for r in payload["iterations"])

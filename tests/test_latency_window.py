@@ -1,0 +1,59 @@
+"""LatencyWindow correctness (observability/registry.py): percentile
+parity vs numpy.percentile, empty/one-sample edges, ring eviction —
+the p50/p99 these windows report are the numbers the serving bench
+gates on and the /metrics page exports, so they get their own pins."""
+
+import numpy as np
+
+from lightgbm_tpu.observability.registry import LatencyWindow
+
+
+def test_percentiles_match_numpy_on_random_windows():
+    rng = np.random.RandomState(7)
+    for trial in range(5):
+        n = int(rng.randint(2, 400))
+        vals = rng.gamma(2.0, 10.0, size=n)  # latency-shaped tail
+        w = LatencyWindow(capacity=1024)
+        for v in vals:
+            w.record(float(v))
+        qs = (50.0, 90.0, 99.0)
+        got = w.percentiles(qs)
+        want = tuple(float(np.percentile(np.asarray(vals, np.float64), q))
+                     for q in qs)
+        assert got == want, f"trial {trial}: {got} != {want}"
+
+
+def test_empty_window_returns_nones():
+    w = LatencyWindow()
+    assert w.percentiles((50.0, 99.0)) == (None, None)
+    assert w.count == 0
+
+
+def test_single_sample_is_every_percentile():
+    w = LatencyWindow()
+    w.record(12.5)
+    p50, p99 = w.percentiles((50.0, 99.0))
+    assert p50 == 12.5 and p99 == 12.5
+    assert w.count == 1
+
+
+def test_ring_bound_evicts_oldest_but_count_is_total():
+    w = LatencyWindow(capacity=100)
+    for v in range(250):
+        w.record(float(v))
+    # only the newest 100 samples remain: values 150..249
+    p0, p100 = w.percentiles((0.0, 100.0))
+    assert p0 == 150.0 and p100 == 249.0
+    # count is the lifetime total, not the retained window
+    assert w.count == 250
+
+
+def test_capacity_floor_and_reset():
+    w = LatencyWindow(capacity=1)  # floored to 16 internally
+    for v in range(20):
+        w.record(float(v))
+    p0, _ = w.percentiles((0.0, 100.0))
+    assert p0 == 4.0  # newest 16 of 20 retained
+    w.reset()
+    assert w.count == 0
+    assert w.percentiles((50.0,)) == (None,)

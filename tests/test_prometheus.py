@@ -1,0 +1,107 @@
+"""Prometheus exposition (observability/prom.py): text-format shape,
+label folding and escaping, the HTTP listener round trip, and the
+trainer-side export path."""
+
+import urllib.request
+
+from lightgbm_tpu.observability.prom import (render_prometheus,
+                                             start_metrics_http)
+from lightgbm_tpu.observability.registry import MetricsRegistry
+
+
+def _parse(page):
+    """{name_or_labelled_series: float} for every sample line."""
+    out = {}
+    for line in page.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        out[name] = float(value)
+    return out
+
+
+def test_render_counters_gauges_and_types():
+    reg = MetricsRegistry()
+    reg.inc("serve_requests", 42)
+    reg.set_gauge("device_bytes_in_use", 1024)
+    page = render_prometheus(registry=reg)
+    assert "# TYPE lgbm_serve_requests counter" in page
+    assert "# TYPE lgbm_device_bytes_in_use gauge" in page
+    samples = _parse(page)
+    assert samples["lgbm_serve_requests"] == 42.0
+    assert samples["lgbm_device_bytes_in_use"] == 1024.0
+    assert page.endswith("\n")
+
+
+def test_labelled_series_fold_and_escape():
+    reg = MetricsRegistry()
+    reg.inc("serve_requests_by_model::higgs", 7)
+    reg.inc("serve_requests_by_model::ctr", 3)
+    reg.inc('serve_requests_by_model::we"ird\nname', 1)
+    page = render_prometheus(registry=reg)
+    # one TYPE line for the family, three labelled samples
+    assert page.count("# TYPE lgbm_serve_requests_by_model counter") == 1
+    samples = _parse(page)
+    assert samples['lgbm_serve_requests_by_model{model="higgs"}'] == 7.0
+    assert samples['lgbm_serve_requests_by_model{model="ctr"}'] == 3.0
+    assert ('lgbm_serve_requests_by_model{model="we\\"ird\\nname"}'
+            in samples)
+
+
+def test_metric_name_sanitization():
+    reg = MetricsRegistry()
+    reg.inc("weird metric-name!", 1)
+    page = render_prometheus(registry=reg)
+    assert "lgbm_weird_metric_name_ 1" in page
+
+
+def test_every_sample_line_is_two_fields():
+    reg = MetricsRegistry()
+    reg.inc("a", 1)
+    reg.inc("b::x", 2)
+    reg.set_gauge("c", 3.75)
+    for line in render_prometheus(registry=reg).splitlines():
+        if line and not line.startswith("#"):
+            assert len(line.rsplit(" ", 1)) == 2
+
+
+def test_http_listener_round_trip():
+    reg = MetricsRegistry()
+    reg.inc("serve_requests", 5)
+    srv = start_metrics_http(port=0, registry=reg)
+    assert srv is not None
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30)
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+        assert _parse(body)["lgbm_serve_requests"] == 5.0
+        # non-/metrics paths 404 instead of serving the page
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/other", timeout=30)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("expected 404")
+    finally:
+        srv.shutdown()
+
+
+def test_cost_model_totals_export(monkeypatch):
+    from lightgbm_tpu.observability.costmodel import global_cost_model
+    prev = global_cost_model.enabled
+    global_cost_model.reset()
+    global_cost_model.enabled = True
+    try:
+        import jax
+        import jax.numpy as jnp
+        from lightgbm_tpu.observability.watchdog import RecompileDetector
+        fn = RecompileDetector(jax.jit(lambda v: v + 1.0), "export_probe")
+        fn(jnp.ones((4,), jnp.float32))
+        page = render_prometheus(registry=MetricsRegistry())
+        assert 'lgbm_cost_calls_total{phase="export_probe"} 1' in page
+    finally:
+        global_cost_model.enabled = prev
+        global_cost_model.reset()

@@ -327,6 +327,71 @@ def test_stats_and_latency_window(served):
     assert "m" in s["models"] and s["models"]["m"]["in_flight"] == 0
 
 
+def test_roofline_stats_and_sampled_traces(served):
+    """ISSUE 11: serving stats carry a measured dispatch-site roofline
+    (warmup excluded) and sampled requests leave stage-waterfall traces
+    in the flight recorder."""
+    from lightgbm_tpu.observability.flightrec import flight_recorder
+    d, _, X = served
+    before = len(flight_recorder.trace_tail(256))
+    # serve_trace_sample defaults to 64: push enough requests through
+    # that at least one gets traced
+    for i in range(70):
+        d.predict("m", X[i % 16:(i % 16) + 4])
+    rl = d.stats().get("roofline")
+    assert rl is not None and rl["dispatches"] >= 70
+    assert rl["measured_mfu"] is not None and rl["measured_mfu"] > 0
+    assert rl["bound"] in ("compute", "hbm")
+    assert rl["flops"] > 0 and rl["dispatch_s"] > 0
+    traces = flight_recorder.trace_tail(256)
+    assert len(traces) > before
+    t = traces[-1]
+    assert t["model"] == "m" and t["version"] >= 1
+    # stage waterfall is monotone: enqueue(0) <= coalesce <= dispatch
+    # <= settle <= respond
+    stages = [t["coalesce_ms"], t["dispatch_ms"],
+              t["device_settle_ms"], t["respond_ms"]]
+    assert all(s is not None for s in stages)
+    assert stages == sorted(stages) and stages[0] >= 0
+    # the coalesce-batch histogram counted these dispatches
+    assert sum(flight_recorder.contents()
+               ["coalesce_batch_requests_hist"]) > 0
+
+
+def test_metrics_port_http_and_op_metrics(served):
+    """The daemon's two scrape surfaces: GET /metrics (fleet-facing)
+    and op=metrics on the TCP wire — same Prometheus text."""
+    import urllib.request
+
+    from lightgbm_tpu.observability import start_metrics_http
+    d, _, X = served
+    d.predict("m", X[:4])
+    srv = start_metrics_http(port=0, daemon=d)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        srv.shutdown()
+    assert "# TYPE lgbm_serve_requests counter" in body
+    assert 'lgbm_serve_latency_ms{quantile="0.99"}' in body
+    assert 'lgbm_serve_model_version{model="m"} 1' in body
+    assert "lgbm_serve_queue_pending" in body
+    assert 'lgbm_serve_requests_by_model{model="m"}' in body
+    fe = start_frontend(d, port=0)
+    try:
+        port = fe.server_address[1]
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            f = s.makefile("rwb")
+            f.write(b'{"op": "metrics"}\n')
+            f.flush()
+            resp = json.loads(f.readline())
+    finally:
+        fe.shutdown()
+    assert resp["ok"]
+    assert "# TYPE lgbm_serve_requests counter" in resp["metrics"]
+
+
 # --------------------------------------------------------------- frontend
 def test_tcp_frontend_round_trip(served):
     d, bst, X = served

@@ -59,23 +59,31 @@ def ladder_volume_model(n, F=FEATURES, B=256, L=NUM_LEAVES, C=2,
 def main():
     import jax
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.observability.costmodel import (backend_peaks,
+                                                      global_cost_model)
 
     X, y = make_higgs_like(ROWS, FEATURES)
     Xte, yte = make_higgs_like(TEST_ROWS, FEATURES, seed=1)
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "learning_rate": 0.1, "max_bin": MAX_BIN,
               "min_data_in_leaf": 20, "verbosity": -1, "metric": "none"}
+    # compiled-cost harvesting ON for the whole run: the harvest is one
+    # .lower().cost_analysis() per traced signature (warmup pays it),
+    # then a dict add per call — the timed loop stays representative
+    global_cost_model.enabled = True
     t0 = time.time()
     booster = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
     for _ in range(WARMUP):
         booster.update()
     _ = np.asarray(booster._gbdt.scores[0][:8])
     setup_s = time.time() - t0
+    cost0 = global_cost_model.snapshot()
     t0 = time.time()
     for _ in range(ITERS):
         booster.update()
     _ = np.asarray(booster._gbdt.scores[0][:8])
     sec_per_iter = (time.time() - t0) / ITERS
+    cost1 = global_cost_model.snapshot()
     auc = _auc(yte, booster._gbdt.predict_raw(Xte))
 
     bytes_per_iter = ladder_volume_model(ROWS)
@@ -84,6 +92,24 @@ def main():
     waves = max(1, math.ceil(math.log2(int(NUM_LEAVES * 1.5)))) + 1
     useful_macs = ROWS * FEATURES * 3 * waves
     mfu = useful_macs * 2 / sec_per_iter / 197e12  # v5e bf16 peak
+
+    # MEASURED cross-check (observability/costmodel.py): XLA's own cost
+    # analysis of the compiled programs that actually ran in the timed
+    # loop, instead of the hand-counted MAC model above.  useful_mac_mfu
+    # counts only the accumulation the algorithm NEEDS; measured_mfu
+    # counts everything the compiled program DOES — the gap between
+    # them is the one-hot overhead the Pallas-histogram item deletes.
+    peak_flops, peak_bw = backend_peaks()
+    meas_flops = meas_bytes = 0.0
+    for group, tot in cost1.items():
+        was = cost0.get(group, {"flops": 0.0, "bytes": 0.0})
+        meas_flops += tot["flops"] - was["flops"]
+        meas_bytes += tot["bytes"] - was["bytes"]
+    meas_flops /= ITERS
+    meas_bytes /= ITERS
+    measured_mfu = meas_flops / sec_per_iter / peak_flops
+    measured_ai = (meas_flops / meas_bytes) if meas_bytes > 0 else None
+    ridge = peak_flops / peak_bw
 
     # measured roofs (tools/bench_bandwidth.py) replace the old nominal
     # 2 TB/s guess, whose "fraction" exceeded 1.0
@@ -118,6 +144,19 @@ def main():
         "min_streamed_bytes_per_iter": round(bytes_per_iter),
         "min_achieved_tbps": round(tbps, 3),
         "useful_mac_mfu": round(mfu, 5),
+        # compiled-HLO cross-check: what XLA says the timed loop's
+        # programs did, vs the analytic MAC count above
+        "measured_mfu": round(measured_mfu, 7),
+        "measured_flops_per_iter": round(meas_flops),
+        "measured_bytes_per_iter": round(meas_bytes),
+        "measured_arithmetic_intensity": (round(measured_ai, 4)
+                                          if measured_ai is not None
+                                          else None),
+        "roofline_bound": ("unknown" if measured_ai is None
+                           else "compute" if measured_ai >= ridge
+                           else "hbm"),
+        "measured_vs_useful_mac_ratio": (round(measured_mfu / mfu, 2)
+                                         if mfu > 0 else None),
         "backend": jax.default_backend(),
         "measured_at": time.strftime("%Y-%m-%d"),
     }

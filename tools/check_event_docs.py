@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Event-schema sync gate: emitted event types <-> docs table.
+
+Config-doc-sync's sibling (tools/gen_params_doc.py --check): the
+structured event log is an interface — bench.py, the distributed
+supervisor, the flight recorder and any fleet tooling key on `event`
+names — so every event type the package can emit must appear in
+docs/Observability.md's event-type reference table, and every table row
+must correspond to a real emitter (no stale rows).
+
+Discovery is syntactic: any call of `emit_event(...)`,
+`emit_event_sync(...)`, `<logger>.emit(...)` or `<logger>.emit_sync(...)`
+whose first argument is a string literal inside lightgbm_tpu/.  The doc
+side is the table between the `<!-- event-table:begin -->` and
+`<!-- event-table:end -->` markers; the first cell of each row lists
+one or more backticked event names.
+
+Usage: python tools/check_event_docs.py   # exit 1 on drift
+"""
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+DOC = os.path.join(REPO, "docs", "Observability.md")
+
+EMIT_NAMES = {"emit_event", "emit_event_sync", "emit", "emit_sync"}
+
+
+def emitted_events():
+    found = {}
+    for root, _dirs, files in os.walk(PKG):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError as e:
+                print(f"check_event_docs: cannot parse {path}: {e}")
+                return None
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name not in EMIT_NAMES:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    rel = os.path.relpath(path, REPO)
+                    found.setdefault(arg.value, f"{rel}:{node.lineno}")
+    return found
+
+
+def documented_events():
+    try:
+        text = open(DOC).read()
+    except OSError as e:
+        print(f"check_event_docs: cannot read {DOC}: {e}")
+        return None
+    m = re.search(r"<!-- event-table:begin -->(.*?)"
+                  r"<!-- event-table:end -->", text, re.S)
+    if not m:
+        print(f"check_event_docs: {DOC} has no "
+              "<!-- event-table:begin/end --> markers")
+        return None
+    names = set()
+    for line in m.group(1).splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        names.update(re.findall(r"`([A-Za-z0-9_]+)`", first_cell))
+    names.discard("event")  # the header row
+    return names
+
+
+def main() -> int:
+    emitted = emitted_events()
+    documented = documented_events()
+    if emitted is None or documented is None:
+        return 1
+    missing = sorted(set(emitted) - documented)
+    stale = sorted(documented - set(emitted))
+    ok = True
+    if missing:
+        ok = False
+        print("events emitted but missing from docs/Observability.md's "
+              "event table:")
+        for name in missing:
+            print(f"  {name}  (first emitter: {emitted[name]})")
+    if stale:
+        ok = False
+        print("events documented but never emitted (stale rows):")
+        for name in stale:
+            print(f"  {name}")
+    if ok:
+        print(f"event table is in sync ({len(emitted)} event types)")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,10 @@ from ..callgraph import cached_walk, module_info_for
 from ..core import Finding, LintContext, Rule, register
 
 _SCOPE_PREFIXES = ("reliability",)
+# terminal-artifact writers outside reliability/: the flight recorder's
+# stall/crash/SIGUSR2 dumps are read by the same supervisor machinery
+# as the stall diagnosis, so they obey the same torn-file discipline
+_SCOPE_FILES = {"observability/flightrec.py"}
 _WRITE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b", "r+", "r+b", "rb+",
                 "x", "xb"}
 _ATOMIC_MARKERS = {"os.replace", "atomic_write_text",
@@ -36,8 +40,10 @@ _ATOMIC_MARKERS = {"os.replace", "atomic_write_text",
 
 
 def _in_scope(pkg_rel: str) -> bool:
-    parts = pkg_rel.replace("\\", "/").split("/")
-    return parts[0] in _SCOPE_PREFIXES and len(parts) > 1
+    rel = pkg_rel.replace("\\", "/")
+    parts = rel.split("/")
+    return (parts[0] in _SCOPE_PREFIXES and len(parts) > 1) \
+        or rel in _SCOPE_FILES
 
 
 def _open_mode(call: ast.Call) -> str:

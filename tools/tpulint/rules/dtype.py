@@ -9,10 +9,12 @@ hot tree-growth path every such literal is a latent recompile or an
 accidental f64/i64 promotion under `jax_enable_x64`, so device code
 spells dtypes out.
 
-Scope: learner/, ops/, parallel/, inference/, serving/, io/device_bin.py
-— the modules whose arrays feed jitted programs (serving/ coalesces and
-dispatches request buckets through them).  Host-side code (metrics,
-plotting, IO parsing) may rely on NumPy-style defaults.
+Scope: learner/, ops/, parallel/, inference/, serving/, io/device_bin.py,
+plus the observability modules that sit against the device runtime
+(costmodel.py harvests lowered programs, watchdog.py fingerprints jitted
+calls) — the modules whose arrays feed jitted programs (serving/
+coalesces and dispatches request buckets through them).  Host-side code
+(metrics, plotting, IO parsing) may rely on NumPy-style defaults.
 """
 
 from __future__ import annotations
@@ -28,7 +30,9 @@ from ..core import Finding, LintContext, Rule, register
 CONSTRUCTORS = {"zeros": 2, "ones": 2, "full": 3, "arange": 4,
                 "array": 2, "empty": 2, "eye": 3}
 SCOPE_DIRS = ("learner", "ops", "parallel", "inference", "serving")
-SCOPE_FILES = {os.path.join("io", "device_bin.py")}
+SCOPE_FILES = {os.path.join("io", "device_bin.py"),
+               os.path.join("observability", "costmodel.py"),
+               os.path.join("observability", "watchdog.py")}
 
 
 def _in_scope(pkg_rel: str) -> bool:

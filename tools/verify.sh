@@ -24,6 +24,9 @@ python -m tools.tpulint lightgbm_tpu --list-suppressions || fail=1
 step "config-doc sync (docs/Parameters.md)"
 python tools/gen_params_doc.py --check || fail=1
 
+step "event-doc sync (docs/Observability.md event table)"
+python tools/check_event_docs.py || fail=1
+
 step "elastic chaos drill (tests/test_elastic.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
