@@ -723,6 +723,32 @@ def main():
     # perf alongside s/iter)
     predict_rows_per_s = _predict_throughput(booster, X)
 
+    # jaxpr-level IR audit over the entries this run actually compiled
+    # (tools/tpulint/ir, ISSUE 12): the BENCH line records that the hot
+    # path it just measured is f64-free and callback-free — the
+    # guard rail the quantized-gradient/Pallas work lands behind.
+    # Groups come from the cost model's window (what dispatched) plus
+    # the inference ladder when the device predict path ran.
+    ir_audit_clean = None
+    ir_audit = {}
+    try:
+        t0 = time.time()
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.tpulint.ir import run_ir_audit
+        _groups = sorted(set(cost_snap1)
+                         | ({"device_predict"}
+                            if "device" in predict_rows_per_s else set()))
+        _findings, _num = run_ir_audit(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "lightgbm_tpu"), groups=_groups)
+        _active = [f for f in _findings if not f.suppressed]
+        ir_audit_clean = not _active
+        ir_audit = {"groups": _groups, "entries_traced": _num,
+                    "findings": len(_active),
+                    "s": round(time.time() - t0, 3)}
+    except Exception as e:  # noqa: BLE001 - the audit must not kill bench
+        ir_audit = {"error": f"{type(e).__name__}: {e}"}
+
     # kernel-correctness gate (tools/kernel_checks.py): the Pallas kernel
     # unit tests skip off-TPU, so the driver's chip run is the only CI
     # that executes them — carry a pass/fail field every round
@@ -791,6 +817,11 @@ def main():
         # serving throughput per predict path (rows/s; *_rows = measured
         # batch — python is subsampled, device shrinks off-TPU)
         "predict_rows_per_s": predict_rows_per_s,
+        # jaxpr-level audit verdict for the entries this run compiled
+        # (docs/StaticAnalysis.md v4): true = hot path proven f64-free,
+        # callback-free, churn-free at the IR level
+        "ir_audit_clean": ir_audit_clean,
+        "ir_audit": ir_audit,
     }
     if mem.get("device_peak_bytes_in_use") is not None:
         out["peak_device_bytes"] = mem["device_peak_bytes_in_use"]

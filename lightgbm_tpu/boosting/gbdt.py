@@ -1906,6 +1906,15 @@ class GBDT:
                                     self.average_output_, convert=conv)
         return sp if sp.ok else None
 
+    def _host_fallback(self, reason: str):
+        """One host-fallback decision of the device-predict router,
+        named by its docs/Inference.md fallback-matrix KEY —
+        tools/check_fallback_docs.py syncs the matrix against these
+        call sites in both directions, so a new quiet host fallback
+        cannot ship undocumented.  Returns None for the caller."""
+        log.debug(f"device_predict: host fallback ({reason})")
+        return None
+
     def _device_predictor(self, X, start_iteration: int, num_iteration: int,
                           pred_early_stop: bool = False):
         """Route decision for the TPU-resident inference path
@@ -1933,11 +1942,16 @@ class GBDT:
             # traversal routes bit-identically to the float64 host path.
             X32 = arr.astype(np.float32)
             if not bool(np.all((X32 == arr) | np.isnan(arr))):
-                return None
+                return self._host_fallback("float64-lossy")
         else:
-            return None
+            return self._host_fallback("non-float-input")
         if mode == "auto" and jax.default_backend() != "tpu":
             return None
+        if jax.process_count() > 1:
+            # predict is a host API; a packed model placed on this
+            # process's devices cannot address remote shards, and the
+            # peers are not running the same dispatch
+            return self._host_fallback("multi-process")
         self._sync_model()
         K = self.num_tree_per_iteration
         total_iters = len(self.models_) // max(K, 1)
@@ -1945,9 +1959,11 @@ class GBDT:
             num_iteration = total_iters - start_iteration
         end = min(start_iteration + num_iteration, total_iters)
         if end <= start_iteration:
-            return None
+            return self._host_fallback("empty-slice")
         dp = self._device_pred_for(start_iteration, end, K)
-        return (dp, X32) if dp.ok else None
+        # dp.ok is False exactly when the slice cannot pack — linear
+        # trees (inference/pack.py) being the one reachable case here
+        return (dp, X32) if dp.ok else self._host_fallback("linear-tree")
 
     def _device_pred_for(self, start_iteration: int, end: int, K: int):
         """Cached DevicePredictor per model slice, invalidated by growth
@@ -2232,6 +2248,9 @@ class GBDT:
         (ref: gbdt.h:314 PredictContrib; tree.h:139; TreeSHAP in
         src/io/tree.cpp)."""
         from ..native import tree_shap
+        # the recursive path-weight algorithm has no device form yet
+        # (ROADMAP "kill the host-fallback matrix")
+        self._host_fallback("pred-contrib")
         self._sync_model()
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:

@@ -80,11 +80,18 @@ def _tree_depth(tree) -> int:
     return depth
 
 
+def _host_fallback(reason: str):
+    """One host-fallback decision of the inference layer, named by its
+    docs/Inference.md fallback-matrix KEY (tools/check_fallback_docs.py
+    syncs matrix and call sites both ways).  Returns None."""
+    return None
+
+
 def pack_ensemble(trees: List) -> Optional[PackedEnsemble]:
     """Pack a model slice; None when the slice cannot be served on device
     (linear-tree leaf models need per-leaf feature ridge evaluations)."""
     if any(getattr(t, "is_linear", False) for t in trees):
-        return None
+        return _host_fallback("linear-tree")
     T = len(trees)
     ni = max([max(t.num_leaves - 1, 1) for t in trees] or [1])
     nl = max([max(t.num_leaves, 1) for t in trees] or [1])

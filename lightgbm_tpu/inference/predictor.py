@@ -36,6 +36,42 @@ from .traverse import (class_scores, class_scores_early_stop,
                        ensemble_leaf_ids)
 
 
+def build_program(depth: int, num_class: int, average: bool, convert,
+                  mode: str, es_freq: int = 0):
+    """The bucket-entry program DevicePredictor jits: (x [B, F] f32,
+    [margin f32 if es_freq > 0,] *pack arrays) -> scores/leaf ids.
+    Module-level so the tpulint IR audit can abstractly trace the SAME
+    program the serving dispatch compiles (lightgbm_tpu/_lint_entries.py)
+    from exemplar shapes alone; DevicePredictor._program is the only
+    runtime caller."""
+    K = num_class
+
+    if es_freq > 0:
+        def run_es(x, margin, sf, th, mt, dl, ic, lc, rc, lv, cs, cn,
+                   cw):
+            leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
+                                     cs, cn, cw, depth)
+            scores = class_scores_early_stop(leaf, lv, K, es_freq,
+                                             margin)
+            if mode == "convert" and convert is not None:
+                scores = convert(scores.T).T
+            return scores
+        return run_es
+
+    def run(x, sf, th, mt, dl, ic, lc, rc, lv, cs, cn, cw):
+        leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
+                                 cs, cn, cw, depth)
+        if mode == "leaf":
+            return leaf
+        scores = class_scores(leaf, lv, K, average)
+        if mode == "convert" and convert is not None:
+            # objectives convert in [K, n] layout (softmax over axis 0)
+            scores = convert(scores.T).T
+        return scores
+
+    return run
+
+
 class DevicePredictor:
     """Jitted ensemble predictor for one model slice.
 
@@ -121,36 +157,8 @@ class DevicePredictor:
 
     # ------------------------------------------------------------ program
     def _program(self, mode: str, es_freq: int = 0):
-        p = self.pack
-        depth = p.max_depth
-        K = self.num_class
-        average = self.average
-        convert = self._convert
-
-        if es_freq > 0:
-            def run_es(x, margin, sf, th, mt, dl, ic, lc, rc, lv, cs, cn,
-                       cw):
-                leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
-                                         cs, cn, cw, depth)
-                scores = class_scores_early_stop(leaf, lv, K, es_freq,
-                                                 margin)
-                if mode == "convert" and convert is not None:
-                    scores = convert(scores.T).T
-                return scores
-            return run_es
-
-        def run(x, sf, th, mt, dl, ic, lc, rc, lv, cs, cn, cw):
-            leaf = ensemble_leaf_ids(x, sf, th, mt, dl, ic, lc, rc,
-                                     cs, cn, cw, depth)
-            if mode == "leaf":
-                return leaf
-            scores = class_scores(leaf, lv, K, average)
-            if mode == "convert" and convert is not None:
-                # objectives convert in [K, n] layout (softmax over axis 0)
-                scores = convert(scores.T).T
-            return scores
-
-        return run
+        return build_program(self.pack.max_depth, self.num_class,
+                             self.average, self._convert, mode, es_freq)
 
     def _fn_for(self, mode: str, bucket: int, F: int, es_freq: int = 0):
         mode_key = f"{mode}+es{es_freq}" if es_freq > 0 else mode

@@ -548,7 +548,9 @@ def grow_tree_impl(binned: jnp.ndarray, grad: jnp.ndarray,
     # ---- root (ref: serial_tree_learner BeforeTrain + root leaf splits) ----
     sum_g0 = jnp.sum(grad)
     sum_h0 = jnp.sum(hess)
-    cnt0 = jnp.sum(row_mask.astype(jnp.int32))
+    # explicit int32 accumulator: jnp.sum promotes int32 to int64 under
+    # x64 (numpy semantics), which would widen the leaf_count scatter
+    cnt0 = jnp.sum(row_mask, dtype=jnp.int32)
     root_hist = None if use_voting else hist_of(ones_mask)
     inf = jnp.asarray(jnp.inf, f32)
     if cegb_used is None:
@@ -668,7 +670,7 @@ def grow_tree_impl(binned: jnp.ndarray, grad: jnp.ndarray,
                                            f32)
                 # stable in-place partition of the segment window; slots
                 # beyond seg_cnt keep their original values
-                cl_seg = jnp.sum(lm.astype(jnp.int32))
+                cl_seg = jnp.sum(lm, dtype=jnp.int32)
                 pos = jnp.where(
                     lm, jnp.cumsum(lm.astype(jnp.int32)) - 1,
                     jnp.where(rm,
@@ -966,8 +968,8 @@ def grow_tree_impl(binned: jnp.ndarray, grad: jnp.ndarray,
                     # non-overlapping feature
                     contig0 = (n_false[:, :, None] - novi) == 0
                     contig1 = (n_false[:, :, None] - novi) == 1
-                    sA_any = jnp.sum(sA.astype(i32_), axis=2)
-                    sB_any = jnp.sum(sB.astype(i32_), axis=2)
+                    sA_any = jnp.sum(sA, axis=2, dtype=i32_)
+                    sB_any = jnp.sum(sB, axis=2, dtype=i32_)
                     qual3A = contig1 & ((sA_any[:, :, None]
                                          - sA.astype(i32_)) >= 1)
                     qual3B = contig1 & ((sB_any[:, :, None]

@@ -535,7 +535,7 @@ def grow_tree_wave_impl(binned: jnp.ndarray, grad: jnp.ndarray,
                 ok = ok & (tree.leaf_depth[fleaf] < params.max_depth)
             split_sel = (jnp.arange(NLp, dtype=i32) == fleaf) & ok
             rank_of = jnp.zeros(NLp, i32)
-            n_split = jnp.sum(split_sel.astype(i32))
+            n_split = jnp.sum(split_sel, dtype=i32)
         else:
             gain = jnp.where(active, best.gain, K_MIN_SCORE)
             if params.max_depth > 0:
@@ -558,7 +558,7 @@ def grow_tree_wave_impl(binned: jnp.ndarray, grad: jnp.ndarray,
             rank_of = jnp.zeros(NLp, i32).at[order].set(
                 jnp.arange(NLp, dtype=i32))
             split_sel = want & (rank_of < budget)
-            n_split = jnp.sum(split_sel.astype(i32))
+            n_split = jnp.sum(split_sel, dtype=i32)
 
         # node/new-leaf numbering by gain rank (leaf-wise split order)
         node_of = jnp.where(split_sel, NL - 1 + rank_of, 0)

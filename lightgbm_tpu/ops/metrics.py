@@ -135,6 +135,67 @@ def build_plans(metrics, config, objective, num_class: int):
     return plans
 
 
+def make_tick_fn(plans, obj, K: int, top_k: int):
+    """The packed eval-tick program: (scores [K, n], label [n], weight
+    [n]|None, pad_mask [n], grad_ok scalar) -> packed f32 vector
+    [metric values..., gradients_finite, scores_finite].  Module-level
+    so the tpulint IR audit can abstractly trace the SAME program
+    DeviceEval jits (lightgbm_tpu/_lint_entries.py) without a trained
+    booster; DeviceEval.__init__ is the only runtime caller."""
+    import jax.numpy as jnp
+
+    def _tick(scores, label, weight, pad_mask, grad_ok):
+        w = pad_mask if weight is None else weight * pad_mask
+        den = jnp.sum(w)
+        outs = []
+        if K > 1:
+            prob = (obj.convert_output(scores) if obj is not None
+                    else scores)
+            lab_oh = (label[None, :]
+                      == jnp.arange(K, dtype=prob.dtype)[:, None])
+            p_lab = jnp.sum(jnp.where(lab_oh, prob, 0.0), axis=0)
+            for _name, kind, _fn in plans:
+                if kind == "multi_logloss":
+                    pt = -jnp.log(jnp.clip(p_lab, 1e-15, 1.0))
+                else:  # multi_error: ties count AGAINST the row
+                    # (ref: multiclass_metric.hpp:142 LossOnPoint)
+                    num_ge = jnp.sum(prob >= p_lab[None, :], axis=0)
+                    pt = (num_ge > top_k).astype(jnp.float32)
+                outs.append(jnp.sum(pt * w) / den)
+        else:
+            sc = scores[0]
+            conv = obj.convert_output(sc) if obj is not None else sc
+            for _name, kind, fn in plans:
+                if kind == _KIND_AUC:
+                    # raw scores, like the host class (AUC is
+                    # rank-based; conversion is monotone)
+                    outs.append(device_exact_auc(sc, label, w))
+                elif kind == _KIND_AP:
+                    outs.append(device_exact_average_precision(
+                        sc, label, w))
+                elif kind == _KIND_MEAN:
+                    # cross_entropy_lambda: z from the UNmasked
+                    # weight, plain mean (xentropy_metric.hpp)
+                    wz = 1.0 if weight is None else weight
+                    z = jnp.clip(1.0 - jnp.exp(-wz * conv),
+                                 1e-15, 1 - 1e-15)
+                    pt = -(label * jnp.log(z)
+                           + (1.0 - label) * jnp.log(1.0 - z))
+                    outs.append(jnp.sum(pt * pad_mask)
+                                / jnp.sum(pad_mask))
+                else:
+                    v = jnp.sum(fn(conv, label) * w) / den
+                    outs.append(jnp.sqrt(v) if kind == _KIND_SQRT
+                                else v)
+        # the non-finite sentinel flags ride the same packed fetch
+        # (engine._check_finite used to sample scores[:, :256])
+        outs.append(grad_ok.astype(jnp.float32))
+        outs.append(jnp.all(jnp.isfinite(scores)).astype(jnp.float32))
+        return jnp.stack(outs)
+
+    return _tick
+
+
 class DeviceEval:
     """One-fetch-per-tick metric evaluator bound to a GBDT's training
     buffers.  `ok` is False when the configuration has no full device
@@ -172,55 +233,7 @@ class DeviceEval:
             self._weight_dev = gbdt._put_by_row(w)
         self._plans = plans
         top_k = int(cfg.multi_error_top_k)
-
-        def _tick(scores, label, weight, pad_mask, grad_ok):
-            w = pad_mask if weight is None else weight * pad_mask
-            den = jnp.sum(w)
-            outs = []
-            if K > 1:
-                prob = (obj.convert_output(scores) if obj is not None
-                        else scores)
-                lab_oh = (label[None, :]
-                          == jnp.arange(K, dtype=prob.dtype)[:, None])
-                p_lab = jnp.sum(jnp.where(lab_oh, prob, 0.0), axis=0)
-                for _name, kind, _fn in plans:
-                    if kind == "multi_logloss":
-                        pt = -jnp.log(jnp.clip(p_lab, 1e-15, 1.0))
-                    else:  # multi_error: ties count AGAINST the row
-                        # (ref: multiclass_metric.hpp:142 LossOnPoint)
-                        num_ge = jnp.sum(prob >= p_lab[None, :], axis=0)
-                        pt = (num_ge > top_k).astype(jnp.float32)
-                    outs.append(jnp.sum(pt * w) / den)
-            else:
-                sc = scores[0]
-                conv = obj.convert_output(sc) if obj is not None else sc
-                for _name, kind, fn in plans:
-                    if kind == _KIND_AUC:
-                        # raw scores, like the host class (AUC is
-                        # rank-based; conversion is monotone)
-                        outs.append(device_exact_auc(sc, label, w))
-                    elif kind == _KIND_AP:
-                        outs.append(device_exact_average_precision(
-                            sc, label, w))
-                    elif kind == _KIND_MEAN:
-                        # cross_entropy_lambda: z from the UNmasked
-                        # weight, plain mean (xentropy_metric.hpp)
-                        wz = 1.0 if weight is None else weight
-                        z = jnp.clip(1.0 - jnp.exp(-wz * conv),
-                                     1e-15, 1 - 1e-15)
-                        pt = -(label * jnp.log(z)
-                               + (1.0 - label) * jnp.log(1.0 - z))
-                        outs.append(jnp.sum(pt * pad_mask)
-                                    / jnp.sum(pad_mask))
-                    else:
-                        v = jnp.sum(fn(conv, label) * w) / den
-                        outs.append(jnp.sqrt(v) if kind == _KIND_SQRT
-                                    else v)
-            # the non-finite sentinel flags ride the same packed fetch
-            # (engine._check_finite used to sample scores[:, :256])
-            outs.append(grad_ok.astype(jnp.float32))
-            outs.append(jnp.all(jnp.isfinite(scores)).astype(jnp.float32))
-            return jnp.stack(outs)
+        _tick = make_tick_fn(plans, obj, K, top_k)
 
         # recompile watchdog + compiled-cost roofline accounting: the
         # packed eval tick is a hot jitted entry like grow/gradients —

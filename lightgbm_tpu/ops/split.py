@@ -218,7 +218,7 @@ def _cat_best_split(grad, hess, cnt_factor, num_bin, sum_g, sum_h, num_data,
     sg_s = jnp.take_along_axis(grad, order, 1)
     sh_s = jnp.take_along_axis(hess, order, 1)
     sc_s = jnp.take_along_axis(cnt, order, 1)
-    used_bin = jnp.sum(valid.astype(i32), axis=1)                # [F]
+    used_bin = jnp.sum(valid, axis=1, dtype=i32)                # [F]
     max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin + 1) // 2)
     steps = min(p.max_cat_threshold, B)
     if p.extra_trees and rand_u is not None:

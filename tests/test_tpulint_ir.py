@@ -1,0 +1,564 @@
+"""tpulint IR layer (ISSUE 12 tentpole): jaxpr-level audit tests.
+
+Mirrors test_tpulint.py's two layers at the IR level:
+
+* fixture tests — per ir-rule, a true positive and a true negative over
+  a synthetic package with its own `_lint_entries.py` manifest,
+  pinning the abstract-trace contract (enable_x64 visibility of
+  weak-type f64, declares-based exemptions, trace-failure reporting);
+* package tests — the IR audit over the real `lightgbm_tpu` manifest
+  must trace every entry and report ZERO findings, and every
+  RecompileDetector-fingerprinted hot-entry group must have a manifest
+  row.
+
+Unlike test_tpulint.py this file DOES import jax (abstract tracing),
+but nothing ever compiles or touches data — each fixture traces in
+tens of milliseconds.
+"""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tools.tpulint import RULES, run_lint  # noqa: E402
+from tools.tpulint.ir import run_ir_audit  # noqa: E402
+
+PACKAGE = os.path.join(_REPO, "lightgbm_tpu")
+
+IR_RULES = ["ir-no-f64", "ir-no-callback", "ir-convert-churn",
+            "ir-giant-constant", "ir-scatter-audit",
+            "ir-manifest-coverage", "ir-trace-error"]
+
+# every fixture package gets a unique name: the manifest is imported
+# for real, and two same-named packages would collide in sys.modules
+_counter = itertools.count()
+
+_MANIFEST_PRELUDE = textwrap.dedent("""
+    ENTRIES = []
+
+    class _E:
+        def __init__(self, name, group, build, declares, line):
+            self.name, self.group = name, group
+            self.build, self.declares, self.line = build, declares, line
+
+    def lint_entry(name, declares=()):
+        def deco(build):
+            ENTRIES.append(_E(name, name.split("[", 1)[0], build,
+                              frozenset(declares),
+                              build.__code__.co_firstlineno))
+            return build
+        return deco
+    """)
+
+
+def _mk_pkg(tmp_path, files):
+    name = f"irfix{os.getpid()}_{next(_counter)}"
+    pkg = tmp_path / name
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    init = pkg / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    return str(pkg)
+
+
+def _manifest_pkg(tmp_path, entries_src, extra_files=None):
+    files = dict(extra_files or {})
+    files["_lint_entries.py"] = _MANIFEST_PRELUDE + textwrap.dedent(
+        entries_src)
+    return _mk_pkg(tmp_path, files)
+
+
+def _ir_lint(tmp_path, entries_src, rules=None, extra_files=None):
+    pkg = _manifest_pkg(tmp_path, entries_src, extra_files)
+    rules = list(rules) + ["ir-trace-error"] if rules else None
+    return run_lint(pkg, rules=rules, ir=True)
+
+
+def _active(report, rule=None):
+    return [f for f in report.active
+            if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------- registry
+def test_ir_rules_registered_and_excluded_by_default():
+    from tools.tpulint import rules as _  # noqa: F401
+    for name in IR_RULES:
+        assert name in RULES and RULES[name].ir, name
+    # a default (non --ir) run must NOT try to trace anything: a
+    # package without a manifest lints clean
+    rep = run_lint(PACKAGE)  # ir=False
+    assert not [f for f in rep.active if f.rule.startswith("ir-")]
+
+
+# ----------------------------------------------------------------- ir-no-f64
+def test_no_f64_weak_type_promotion_tp(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[f64]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()   # f64 under x64
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-f64"])
+    fs = _active(rep, "ir-no-f64")
+    assert fs, rep.render_text()
+    assert any("float64" in f.message for f in fs)
+    assert all(f.path.endswith("_lint_entries.py") for f in fs)
+    assert not _active(rep, "ir-trace-error")
+
+
+def test_no_f64_clean_f32_tn(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[f32]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0], np.float32)).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-f64"])
+    assert not _active(rep), rep.render_text()
+
+
+# ------------------------------------------------------------ ir-no-callback
+def test_no_callback_pure_callback_tp(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[cb]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct((), np.float32), x[0])
+            return x.sum() + y
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-callback"])
+    fs = _active(rep, "ir-no-callback")
+    assert fs and "pure_callback" in fs[0].message, rep.render_text()
+
+
+def test_no_callback_debug_print_tp_and_clean_tn(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[dbg]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            jax.debug.print("x0 {}", x[0])
+            return x.sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+
+    @lint_entry("hot[clean]")
+    def _b2():
+        import jax, numpy as np
+        def f(x):
+            return x.sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-callback"])
+    fs = _active(rep, "ir-no-callback")
+    assert len(fs) == 1 and "[hot[dbg]]" in fs[0].message, \
+        rep.render_text()
+
+
+# --------------------------------------------------------- ir-convert-churn
+def test_convert_churn_round_trip_tp(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[churn]")
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        def f(x):
+            return x.astype(jnp.float64).astype(jnp.float32) + 1.0
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-convert-churn"])
+    fs = _active(rep, "ir-convert-churn")
+    assert fs and "float32 -> float64 -> float32" in fs[0].message, \
+        rep.render_text()
+
+
+def test_convert_churn_precision_squeeze_and_compute_tn(tmp_path):
+    # f32->bf16->f32 is a deliberate precision squeeze; a round trip
+    # WITH intervening compute is semantic — neither is churn
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[squeeze]")
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        def f(x):
+            a = x.astype(jnp.bfloat16).astype(jnp.float32)
+            b = (x.astype(jnp.float64) + 1.0).astype(jnp.float32)
+            return a + b
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-convert-churn"])
+    assert not _active(rep, "ir-convert-churn"), rep.render_text()
+
+
+# -------------------------------------------------------- ir-giant-constant
+def test_giant_constant_tp_and_tn(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[giant]")
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        big = jnp.zeros(100_000, jnp.float32)     # 400 KB baked in
+        small = jnp.zeros(16, jnp.float32)
+        def f(x):
+            return x + big[:x.shape[0]] + small[:x.shape[0]].sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-giant-constant"])
+    fs = _active(rep, "ir-giant-constant")
+    assert len(fs) == 1 and "391 KiB" in fs[0].message, rep.render_text()
+
+
+# --------------------------------------------------------- ir-scatter-audit
+def test_scatter_audit_undeclared_onehot_dot_tp(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[onehot]")
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        def f(codes, vals):
+            oh = (codes[:, None]
+                  == jnp.arange(16, dtype=jnp.int32)[None, :])
+            return oh.astype(jnp.float32).T @ vals
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.int32),
+                            jax.ShapeDtypeStruct((64,), np.float32))
+    """, rules=["ir-scatter-audit"])
+    fs = _active(rep, "ir-scatter-audit")
+    assert fs and "one-hot" in fs[0].message, rep.render_text()
+
+
+def test_scatter_audit_declared_onehot_dot_tn(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[onehot]", declares=("onehot-dot",))
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        def f(codes, vals):
+            oh = (codes[:, None]
+                  == jnp.arange(16, dtype=jnp.int32)[None, :])
+            return oh.astype(jnp.float32).T @ vals
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.int32),
+                            jax.ShapeDtypeStruct((64,), np.float32))
+    """, rules=["ir-scatter-audit"])
+    assert not _active(rep), rep.render_text()
+
+
+def test_scatter_audit_narrow_accumulator_tp_tn(tmp_path):
+    src = """
+
+    @lint_entry("hot[i8]"{declares})
+    def _b():
+        import jax, numpy as np
+        import jax.numpy as jnp
+        def f(idx, vals):
+            acc = jnp.zeros(16, jnp.int8)
+            return acc.at[idx].add(vals)
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.int32),
+                            jax.ShapeDtypeStruct((64,), np.int8))
+    """
+    rep = _ir_lint(tmp_path, src.format(declares=""),
+                   rules=["ir-scatter-audit"])
+    fs = _active(rep, "ir-scatter-audit")
+    assert fs and "int8 scatter accumulator" in fs[0].message, \
+        rep.render_text()
+    rep2 = _ir_lint(tmp_path,
+                    src.format(declares=", declares=('narrow-acc',)"),
+                    rules=["ir-scatter-audit"])
+    assert not _active(rep2), rep2.render_text()
+
+
+# ----------------------------------------------------------- ir-trace-error
+def test_trace_error_builder_raises(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[broken]")
+    def _b():
+        raise RuntimeError("boom")
+    """, rules=["ir-no-f64"])
+    fs = _active(rep, "ir-trace-error")
+    assert fs and "boom" in fs[0].message, rep.render_text()
+
+
+def test_trace_error_missing_manifest(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"m.py": "x = 1\n"})
+    rep = run_lint(pkg, rules=["ir-trace-error"], ir=True)
+    fs = _active(rep, "ir-trace-error")
+    assert fs and "_lint_entries.py" in fs[0].message, rep.render_text()
+
+
+# ------------------------------------------------------ ir-manifest-coverage
+def test_manifest_coverage_missing_group_tp(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("covered[x]")
+    def _b():
+        import jax, numpy as np
+        return (jax.jit(lambda x: x + 1.0),
+                (jax.ShapeDtypeStruct((8,), np.float32),))
+    """, rules=["ir-manifest-coverage"], extra_files={"hot.py": """
+        from .obs import RecompileDetector
+
+        def setup(fn):
+            wrapped = RecompileDetector(fn, "covered")
+            other = RecompileDetector(fn, "uncovered_entry")
+            ladder = RecompileDetector(fn, f"covered[raw@{4096}]")
+            return wrapped, other, ladder
+        """, "obs.py": """
+        class RecompileDetector:
+            def __init__(self, fn, name):
+                self.fn, self.name = fn, name
+        """})
+    fs = _active(rep, "ir-manifest-coverage")
+    assert len(fs) == 1 and "uncovered_entry" in fs[0].message, \
+        rep.render_text()
+    assert fs[0].path.endswith("hot.py")  # anchored at the detector site
+
+
+# ------------------------------------------------------------- suppressions
+def test_ir_finding_suppressible_at_manifest_line(tmp_path):
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[f64]")  # tpulint: disable=ir-no-f64 -- fixture: deliberate f64
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-f64"])
+    assert not _active(rep), rep.render_text()
+    assert rep.suppressed and \
+        rep.suppressed[0].justification.startswith("fixture")
+
+
+# ------------------------------------------------------------- determinism
+def test_ir_jobs_serial_equals_parallel(tmp_path):
+    src = """
+
+    @lint_entry("hot[f64]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """
+    pkg = _manifest_pkg(tmp_path, src)
+    r1 = run_lint(pkg, ir=True, jobs=1)
+    r4 = run_lint(pkg, ir=True, jobs=4)
+    key = lambda r: [(f.rule, f.path, f.line, f.message)  # noqa: E731
+                     for f in r.active]
+    assert key(r1) == key(r4) and key(r1)
+
+
+# ------------------------------------------------------------------- cache
+def test_ir_results_cached_per_entry_and_invalidated(tmp_path,
+                                                    monkeypatch):
+    src = """
+
+    @lint_entry("hot[f64]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """
+    pkg = _manifest_pkg(tmp_path, src)
+    cache = str(tmp_path / "cache.json")
+    r1 = run_lint(pkg, ir=True, cache_path=cache)
+    assert _active(r1, "ir-no-f64")
+    stored = json.load(open(cache))
+    assert "ir" in stored and stored["ir"]["entry_sigs"], \
+        "per-entry signatures recorded"
+    # a warm re-run must replay from the cache without tracing
+    import tools.tpulint.ir.rules as ir_rules
+
+    def _boom(*a, **k):
+        raise AssertionError("IR pass re-ran on an unchanged package")
+    monkeypatch.setattr(ir_rules, "run_ir_pass", _boom)
+    r2 = run_lint(pkg, ir=True, cache_path=cache)
+    assert [(f.rule, f.line) for f in r2.active] == \
+        [(f.rule, f.line) for f in r1.active]
+    monkeypatch.undo()
+    # editing any package source invalidates the IR section (content
+    # hash key), even when the mtime is restored
+    mf = os.path.join(pkg, "_lint_entries.py")
+    st = os.stat(mf)
+    with open(mf, "a") as f:
+        f.write("\n# content change\n")
+    os.utime(mf, ns=(st.st_atime_ns, st.st_mtime_ns))
+    seen = []
+    real = ir_rules.run_ir_pass
+    monkeypatch.setattr(ir_rules, "run_ir_pass",
+                        lambda *a, **k: seen.append(1) or real(*a, **k))
+    run_lint(pkg, ir=True, cache_path=cache)
+    assert seen, "content change must re-run the IR pass"
+
+
+# ---------------------------------------------------------------- CLI / e2e
+@pytest.mark.slow
+def test_cli_ir_exit_codes(tmp_path):
+    pkg = _manifest_pkg(tmp_path, """
+
+    @lint_entry("hot[f64]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """)
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", pkg, "--ir",
+         "--format=json", "--no-cache"],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r.returncode == 1, r.stderr
+    rep = json.loads(r.stdout)
+    # two findings: the baked f64 constant + the introducing convert
+    assert rep["counts"].get("ir-no-f64", 0) >= 1, rep["counts"]
+    # without --ir the same package is clean (no ir rules selected)
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", pkg, "--no-cache"],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r2.returncode == 0, r2.stdout
+
+
+def test_sarif_includes_ir_findings(tmp_path):
+    from tools.tpulint.core import to_sarif
+    rep = _ir_lint(tmp_path, """
+
+    @lint_entry("hot[f64]")
+    def _b():
+        import jax, numpy as np
+        def f(x):
+            return (x * np.asarray([2.0])).sum()
+        return jax.jit(f), (jax.ShapeDtypeStruct((64,), np.float32),)
+    """, rules=["ir-no-f64"])
+    sarif = to_sarif(rep)
+    results = sarif["runs"][0]["results"]
+    assert any(r["ruleId"] == "ir-no-f64" for r in results)
+    rules_meta = sarif["runs"][0]["tool"]["driver"]["rules"]
+    assert any(r["id"] == "ir-no-f64" and "shortDescription" in r
+               for r in rules_meta)
+
+
+# ------------------------------------------------------------ package gates
+@pytest.fixture(scope="module")
+def package_ir_report():
+    return run_lint(PACKAGE, ir=True)
+
+
+def test_package_ir_audit_clean(package_ir_report):
+    active = [f for f in package_ir_report.active
+              if f.rule.startswith("ir-")]
+    assert not active, "\n".join(f.render() for f in active)
+
+
+@pytest.mark.parametrize("rule", IR_RULES)
+def test_package_clean_per_ir_family(package_ir_report, rule):
+    fs = [f for f in package_ir_report.active if f.rule == rule]
+    assert not fs, "\n".join(f.render() for f in fs)
+
+
+def test_package_manifest_covers_every_detector_group():
+    from tools.tpulint.core import LintContext
+    from tools.tpulint.ir.rules import detector_sites
+    from tools.tpulint.ir.trace import load_manifest
+    entries, err = load_manifest(PACKAGE)
+    assert err is None, err
+    covered = {e.group for e in entries}
+    ctx = LintContext(PACKAGE)
+    runtime = {g for _p, _l, g in detector_sites(ctx)}
+    # the four hot-entry families the cost model/recompile watchdog
+    # fingerprint today, plus anything added later
+    assert {"grow_tree", "gradients", "device_eval",
+            "device_predict"} <= runtime
+    assert runtime <= covered, f"uncovered groups: {runtime - covered}"
+
+
+def test_package_every_entry_traces():
+    findings, num = run_ir_audit(PACKAGE)
+    from lightgbm_tpu._lint_entries import ENTRIES
+    assert num == len(ENTRIES) and num >= 15
+    assert not [f for f in findings if not f.suppressed]
+
+
+def test_group_filter_restricts_tracing():
+    findings, num = run_ir_audit(PACKAGE, groups=["gradients"])
+    from lightgbm_tpu._lint_entries import ENTRIES
+    expect = sum(1 for e in ENTRIES if e.group == "gradients")
+    assert num == expect >= 2
+    assert not [f for f in findings if not f.suppressed]
+
+
+# ----------------------------------------------- cache-staleness regression
+def test_tool_fingerprint_is_content_hashed(tmp_path):
+    """ISSUE 12 satellite: editing a RULE with (mtime, size) preserved
+    must still invalidate the cache — the fingerprint hashes content."""
+    from tools.tpulint.core import _tool_fingerprint
+    d = tmp_path / "tool"
+    d.mkdir()
+    p = d / "rule.py"
+    p.write_text("FLAG = True \n")
+    st = os.stat(p)
+    fp1 = _tool_fingerprint(str(d))
+    # same byte LENGTH, same mtime — only the content differs (the
+    # git-checkout / same-second-editor-save shape)
+    p.write_text("FLAG = False\n")
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert os.stat(p).st_size == st.st_size
+    assert os.stat(p).st_mtime_ns == st.st_mtime_ns
+    fp2 = _tool_fingerprint(str(d))
+    assert fp1 != fp2, "mtime/size-keyed fingerprint served stale rules"
+
+
+def test_rule_edit_invalidates_cached_report(tmp_path, monkeypatch):
+    """End to end: with a cache on disk, a changed tool fingerprint
+    (the content hash) must force a full re-lint."""
+    import tools.tpulint.core as core
+    pkg = _mk_pkg(tmp_path, {"learner/m.py": """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros(n)
+        """})
+    cache = str(tmp_path / "c.json")
+    r1 = run_lint(pkg, rules=["explicit-dtype"], cache_path=cache)
+    assert len(r1.active) == 1
+    # simulate a rule edit: the content fingerprint changes even though
+    # every mtime stayed put
+    real_fp = core._tool_fingerprint()
+    monkeypatch.setattr(core, "_tool_fingerprint",
+                        lambda d=None: real_fp + [["edited-rule.py",
+                                                   "deadbeef"]])
+    calls = []
+    real_ctx = core.LintContext
+
+    class _SpyCtx(real_ctx):
+        def __init__(self, *a, **k):
+            calls.append(1)
+            super().__init__(*a, **k)
+    monkeypatch.setattr(core, "LintContext", _SpyCtx)
+    r2 = run_lint(pkg, rules=["explicit-dtype"], cache_path=cache)
+    assert len(r2.active) == 1
+    # the cache was NOT served from the stale meta: the stored meta
+    # mismatches, so findings were recomputed (and the cache rewritten
+    # under the new fingerprint)
+    stored = json.load(open(cache))
+    assert stored["meta"]["tool"][-1] == ["edited-rule.py", "deadbeef"]

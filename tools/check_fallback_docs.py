@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Fallback-matrix sync gate: host-fallback branches <-> docs table.
+
+check_event_docs.py's sibling for the inference router: the ROADMAP's
+"kill the host-fallback matrix" item only works if the matrix is TRUE —
+a production daemon quietly serving requests at Python speed because of
+an undocumented fallback is exactly the regression this gate blocks.
+Every host-fallback decision in the device-predict router calls
+`_host_fallback("<key>")` (gbdt._device_predictor, inference/pack.py),
+and docs/Inference.md's fallback matrix lists one row per key between
+the `<!-- fallback-matrix:begin/end -->` markers.  Both directions are
+enforced: an undocumented call-site key fails, and a documented key
+with no call site (a fallback that was CLOSED — the end state the
+ROADMAP wants) fails as stale until the row is removed.
+
+Discovery is syntactic, like the event gate: any call of
+`_host_fallback(...)` (name or attribute form) whose first argument is
+a string literal inside lightgbm_tpu/.
+
+Usage: python tools/check_fallback_docs.py   # exit 1 on drift
+"""
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+DOC = os.path.join(REPO, "docs", "Inference.md")
+
+FALLBACK_NAMES = {"_host_fallback"}
+
+
+def code_fallbacks():
+    found = {}
+    for root, _dirs, files in os.walk(PKG):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError as e:
+                print(f"check_fallback_docs: cannot parse {path}: {e}")
+                return None
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name not in FALLBACK_NAMES:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    rel = os.path.relpath(path, REPO)
+                    found.setdefault(arg.value, f"{rel}:{node.lineno}")
+    return found
+
+
+def documented_fallbacks():
+    try:
+        text = open(DOC).read()
+    except OSError as e:
+        print(f"check_fallback_docs: cannot read {DOC}: {e}")
+        return None
+    m = re.search(r"<!-- fallback-matrix:begin -->(.*?)"
+                  r"<!-- fallback-matrix:end -->", text, re.S)
+    if not m:
+        print(f"check_fallback_docs: {DOC} has no "
+              "<!-- fallback-matrix:begin/end --> markers")
+        return None
+    keys = set()
+    for line in m.group(1).splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        keys.update(re.findall(r"`([A-Za-z0-9_\-]+)`", first_cell))
+    keys.discard("key")  # the header row
+    return keys
+
+
+def main() -> int:
+    in_code = code_fallbacks()
+    in_docs = documented_fallbacks()
+    if in_code is None or in_docs is None:
+        return 1
+    missing = sorted(set(in_code) - in_docs)
+    stale = sorted(in_docs - set(in_code))
+    ok = True
+    if missing:
+        ok = False
+        print("host fallbacks in code but missing from "
+              "docs/Inference.md's fallback matrix:")
+        for key in missing:
+            print(f"  {key}  (call site: {in_code[key]})")
+    if stale:
+        ok = False
+        print("fallback rows documented but with no _host_fallback call "
+              "site (fallback closed? remove the row):")
+        for key in stale:
+            print(f"  {key}")
+    if ok:
+        print(f"fallback matrix is in sync ({len(in_code)} fallback "
+              "key(s))")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

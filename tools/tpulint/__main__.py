@@ -7,6 +7,11 @@ tests/test_tpulint.py; external CI calls this exactly the same way):
     1  unsuppressed/new findings (or a rule/usage error)
 
 Options:
+    --ir                       additionally run the jaxpr-level IR
+                               audit over the package's
+                               _lint_entries.py manifest (abstract
+                               trace of every hot jitted entry;
+                               docs/StaticAnalysis.md v4)
     --format=text|json|github|sarif
                                report format (github emits workflow
                                annotations ::error file=...,line=...;
@@ -49,6 +54,10 @@ def main(argv=None) -> int:
         description="JAX/TPU-aware static analysis (docs/StaticAnalysis.md)")
     ap.add_argument("package_dir", nargs="?", default="lightgbm_tpu",
                     help="package tree to lint (default: lightgbm_tpu)")
+    ap.add_argument("--ir", action="store_true",
+                    help="additionally run the jaxpr-level IR audit "
+                         "(abstract trace of the _lint_entries.py "
+                         "manifest entries)")
     ap.add_argument("--format", choices=("text", "json", "github",
                                          "sarif"),
                     default="text")
@@ -80,7 +89,7 @@ def main(argv=None) -> int:
         cache = (None if args.no_cache
                  else default_cache_path(args.package_dir))
         for path, line, rules, why, used in sorted(audit_suppressions(
-                args.package_dir, cache_path=cache)):
+                args.package_dir, cache_path=cache, ir=args.ir)):
             n += 1
             mark = ""
             if not used:
@@ -96,7 +105,7 @@ def main(argv=None) -> int:
     cache = None if args.no_cache else default_cache_path(args.package_dir)
     try:
         report = run_lint(args.package_dir, rules=rules, cache_path=cache,
-                          jobs=args.jobs)
+                          jobs=args.jobs, ir=args.ir)
     except KeyError as e:
         sys.stderr.write(f"tpulint: {e.args[0]}\n")
         return 1

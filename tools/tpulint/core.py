@@ -149,10 +149,16 @@ class Rule:
     `file_local = True` and implement `check_file(ctx, pf)`; the
     mtime-keyed cache then reuses their per-file results for unchanged
     files.  Graph rules (anything consuming the jit call graph) stay
-    file_local = False and re-run whenever any file changed."""
+    file_local = False and re-run whenever any file changed.
+
+    Rules with `ir = True` (tools/tpulint/ir/) run over abstractly
+    traced jaxprs of the package's manifest entries instead of ASTs;
+    they are selected only by `--ir` (or by explicit name) and driven
+    by the shared IR pass, never the per-file loop."""
     name: str = ""
     description: str = ""
     file_local: bool = False
+    ir: bool = False
 
     def check(self, ctx: LintContext) -> List[Finding]:
         if not self.file_local:
@@ -238,25 +244,30 @@ def _apply_suppressions(ctx: LintContext, findings: List[Finding]
 # mtime-keyed analysis cache (docs/StaticAnalysis.md "Caching"): the
 # full-package lint re-parses every file and rebuilds the jit call
 # graph, which grows with the package.  The cache keys on every file's
-# (mtime_ns, size) plus tpulint's own sources: a fully-unchanged
-# package returns the stored report without any analysis (sub-second);
-# when only some files changed, file-local rules reuse their per-file
-# results for the unchanged ones and graph rules re-run.
+# (mtime_ns, size) plus a CONTENT hash of tpulint's own sources: a
+# fully-unchanged package returns the stored report without any
+# analysis (sub-second); when only some files changed, file-local rules
+# reuse their per-file results for the unchanged ones and graph rules
+# re-run.  The tool side hashes content, not mtimes (ISSUE 12): a rule
+# edit that preserves (mtime, size) — git checkout/stash restores,
+# build-system copies, same-second editor saves — previously served
+# STALE per-file results for the edited rule until --no-cache.
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2
 
 
-def _tool_fingerprint() -> List:
-    d = os.path.dirname(os.path.abspath(__file__))
+def _tool_fingerprint(tool_dir: Optional[str] = None) -> List:
+    import hashlib
+    d = tool_dir or os.path.dirname(os.path.abspath(__file__))
     items: List = []
     for root, dirs, files in os.walk(d):
         dirs[:] = sorted(x for x in dirs if x != "__pycache__")
         for fname in sorted(files):
             if fname.endswith(".py"):
                 p = os.path.join(root, fname)
-                st = os.stat(p)
-                items.append([os.path.relpath(p, d),
-                              int(st.st_mtime_ns), st.st_size])
+                with open(p, "rb") as f:
+                    digest = hashlib.sha256(f.read()).hexdigest()[:16]
+                items.append([os.path.relpath(p, d), digest])
     return items
 
 
@@ -351,22 +362,68 @@ def _run_file_local(ctx, pending: List[Tuple[str, List[str]]],
     return out
 
 
+def _package_source_hash(ctx: LintContext) -> str:
+    """Content hash of every source file of the linted tree — the
+    conservative key for the IR result cache (an edit anywhere can
+    change a traced program through imports)."""
+    import hashlib
+    h = hashlib.sha256()
+    for pf in sorted(ctx.files, key=lambda p: p.rel):
+        h.update(pf.rel.encode())
+        h.update(b"\0")
+        h.update(pf.source.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def _ir_findings_and_section(ctx: LintContext, ir_selected: List[str],
+                             cache: Optional[Dict], key: Dict
+                             ) -> Tuple[List[Finding], Dict]:
+    """The IR pass behind its own cache section: results are stored
+    per run keyed on (package content hash, tool content hash, rule
+    set), with each traced entry's exemplar-signature hash recorded
+    (docs/StaticAnalysis.md v4 "Caching") — a key hit replays the
+    findings without importing jax or tracing anything."""
+    cached = (cache or {}).get("ir")
+    if cached is not None and cached.get("key") == key:
+        fs = [Finding(**d) for d in cached.get("findings", [])]
+        for f in fs:
+            f.suppressed, f.justification = False, ""
+        return fs, cached
+    from .ir.rules import run_ir_pass
+    fs, _n, sigs = run_ir_pass(ctx, rule_names=list(ir_selected))
+    section = {"key": key, "entry_sigs": sigs,
+               "findings": [dict(f.to_dict(), suppressed=False,
+                                 justification="") for f in fs]}
+    return fs, section
+
+
 def run_lint(package_dir: str, rules: Optional[List[str]] = None,
              docs_dir: Optional[str] = None,
              cache_path: Optional[str] = None,
-             jobs: Optional[int] = None) -> Report:
+             jobs: Optional[int] = None, ir: bool = False) -> Report:
     """Run the (selected) rules over one package tree.  With
     `cache_path`, reuse mtime-keyed results (see module comment); with
     `jobs` != 1, fan the per-file rule passes out across a process pool
-    (None = one worker per CPU)."""
+    (None = one worker per CPU).  `ir=True` additionally runs the
+    jaxpr-level rules over the package's `_lint_entries.py` manifest
+    (tools/tpulint/ir/); ir rules also run when named in `rules`."""
     # rule modules self-register on import
     from . import rules as _rules  # noqa: F401
     ctx = LintContext(package_dir, docs_dir=docs_dir)
-    selected = list(RULES) if rules is None else list(rules)
-    for name in selected:
-        if name not in RULES:
-            raise KeyError(f"unknown tpulint rule: {name} "
-                           f"(known: {', '.join(sorted(RULES))})")
+    if rules is None:
+        selected = [n for n in RULES if not RULES[n].ir]
+        ir_selected = sorted(n for n in RULES if RULES[n].ir) if ir \
+            else []
+    else:
+        for name in rules:
+            if name not in RULES:
+                raise KeyError(f"unknown tpulint rule: {name} "
+                               f"(known: {', '.join(sorted(RULES))})")
+        selected = [n for n in rules if not RULES[n].ir]
+        ir_selected = [n for n in rules if RULES[n].ir]
+        if ir and not ir_selected:
+            ir_selected = sorted(n for n in RULES if RULES[n].ir)
 
     fkeys = {pf.rel: _stat_key(pf.abspath) for pf in ctx.files}
     meta = {"version": CACHE_VERSION, "tool": _tool_fingerprint(),
@@ -376,9 +433,26 @@ def run_lint(package_dir: str, rules: Optional[List[str]] = None,
     cache = _load_cache(cache_path) if cache_path else None
     if cache is not None and cache.get("meta") != meta:
         cache = None  # tool or rule set changed: full invalidation
+    ir_key = ({"pkg": _package_source_hash(ctx), "tool": meta["tool"],
+               "rules": sorted(ir_selected)} if ir_selected else None)
     if cache is not None and cache.get("files") == fkeys:
-        return Report(findings=[Finding(**d)
-                                for d in cache.get("findings", [])])
+        if not ir_selected:
+            return Report(findings=[Finding(**d)
+                                    for d in cache.get("findings", [])])
+        # AST results replay from cache; the IR section replays or
+        # re-traces on its own key, then suppressions re-apply to the
+        # merged list (bad-suppression findings regenerate there)
+        ast_findings = [Finding(**d) for d in cache.get("findings", [])
+                        if d.get("rule") != "bad-suppression"]
+        for f in ast_findings:
+            f.suppressed, f.justification = False, ""
+        ir_findings, ir_section = _ir_findings_and_section(
+            ctx, ir_selected, cache, ir_key)
+        merged = _apply_suppressions(ctx, ast_findings + ir_findings)
+        merged.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        if cache_path:
+            _save_cache(cache_path, dict(cache, ir=ir_section))
+        return Report(findings=merged)
 
     findings: List[Finding] = []
     for pf in ctx.files:
@@ -420,14 +494,24 @@ def run_lint(package_dir: str, rules: Optional[List[str]] = None,
         per_file.setdefault(rel, {})[name] = [
             dict(d, suppressed=False, justification="") for d in dicts]
         findings.extend(fs)
+    ir_section = (cache or {}).get("ir")
+    if ir_selected:
+        ir_findings, ir_section = _ir_findings_and_section(
+            ctx, ir_selected, cache, ir_key)
+        findings.extend(ir_findings)
     findings = _apply_suppressions(ctx, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report = Report(findings=findings)
     if cache_path:
-        _save_cache(cache_path, {
-            "meta": meta, "files": fkeys,
-            "findings": [f.to_dict() for f in report.findings],
-            "per_file": per_file})
+        # the stored findings stay AST-only: a later non-ir run's
+        # short-circuit must not replay IR findings it did not select
+        ast_only = [f.to_dict() for f in report.findings
+                    if not getattr(RULES.get(f.rule), "ir", False)]
+        payload = {"meta": meta, "files": fkeys, "findings": ast_only,
+                   "per_file": per_file}
+        if ir_section is not None:
+            payload["ir"] = ir_section
+        _save_cache(cache_path, payload)
     return report
 
 
@@ -529,15 +613,18 @@ def iter_suppressions(package_dir: str):
 
 
 def audit_suppressions(package_dir: str,
-                       cache_path: Optional[str] = None):
+                       cache_path: Optional[str] = None,
+                       ir: bool = False):
     """`iter_suppressions` plus a liveness verdict: the full rule suite
     runs and each suppression is matched against the findings it
     actually masked.  A suppression masking NOTHING is stale — its
     finding was resolved (the way `wave.py:_psum` resolved when the v2
     graph closed the shard_map distance) and keeping the comment would
-    silently swallow a future regression at that line.  Yields
+    silently swallow a future regression at that line.  With `ir`, the
+    jaxpr-level rules run too, so a manifest-line ir suppression
+    registers as live.  Yields
     (rel_path, comment_line, rules, justification, used)."""
-    report = run_lint(package_dir, cache_path=cache_path)
+    report = run_lint(package_dir, cache_path=cache_path, ir=ir)
     masked = {(f.path, f.line, f.rule) for f in report.suppressed}
     ctx = LintContext(package_dir)
     for pf in ctx.files:

@@ -1,0 +1,36 @@
+"""tpulint IR layer: jaxpr-level audit of the hot jitted entries.
+
+The AST rules (tools/tpulint/rules/) see the SOURCE; this layer sees
+the artifact that actually runs on the chip.  Every hot entry declared
+in the linted package's `_lint_entries.py` manifest is abstractly
+traced (jax .trace on exemplar ShapeDtypeStructs — no device, no data,
+no compile) to its ClosedJaxpr, and the `ir-*` rule passes walk the
+equations: float64 leaks, host callbacks, convert round trips, baked-in
+giant constants and undeclared histogram shapes all live at this level
+and are invisible to any AST rule.  Findings anchor at the manifest
+entry's declaration line, so the ordinary per-line suppression syntax
+(and the baseline/SARIF machinery) applies unchanged.
+
+Entry via `python -m tools.tpulint --ir` (core.run_lint(ir=True)), or
+programmatically through `run_ir_audit` (bench.py's `ir_audit_clean`).
+"""
+
+from .trace import load_manifest, trace_entry  # noqa: F401
+from . import rules as _rules  # noqa: F401  (registers the ir-* rules)
+
+
+def run_ir_audit(package_dir: str, groups=None):
+    """Standalone IR audit for tooling (bench.py): trace the manifest
+    entries of `package_dir` (optionally restricted to detector
+    `groups`) and run every ir rule.  Returns (findings, num_traced) —
+    `findings` already has per-line suppressions applied."""
+    from ..core import LintContext, _apply_suppressions
+    from .rules import run_ir_pass
+    ctx = LintContext(package_dir)
+    findings, num_traced, _sigs = run_ir_pass(ctx, rule_names=None,
+                                              groups=groups)
+    findings = _apply_suppressions(ctx, findings)
+    # _apply_suppressions may append bad-suppression findings for the
+    # whole package; an audit scoped to the manifest keeps only its own
+    findings = [f for f in findings if f.rule.startswith("ir-")]
+    return findings, num_traced

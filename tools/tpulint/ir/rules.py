@@ -1,0 +1,391 @@
+"""The ir-* rule passes over abstractly traced ClosedJaxprs.
+
+Each rule implements `check_entry(ctx, rel, entry, closed)` — one
+traced manifest entry at a time — and anchors its findings at the
+entry's declaration line in `<package>/_lint_entries.py`, so the
+ordinary `# tpulint: disable=<rule> -- why` suppression syntax applies.
+Pattern-level exemptions (a deliberate one-hot-dot histogram, a
+deliberate sub-32-bit accumulator) are declared ON the entry instead
+(`declares`), keeping the justification next to the entry it covers.
+
+Rules (docs/StaticAnalysis.md v4):
+
+* ir-no-f64          — float64 introduced anywhere in device code
+* ir-no-callback     — host callbacks / transfers inside a hot entry
+* ir-convert-churn   — convert_element_type round trips
+* ir-giant-constant  — large literals baked into the program
+* ir-scatter-audit   — histogram-path scatter/gather/one-hot shapes
+* ir-manifest-coverage — every RecompileDetector entry has a manifest row
+* ir-trace-error     — manifest/builder/trace failures (never silent)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, LintContext, Rule, register
+from .trace import (aval_of, dtype_name, iter_eqns, iter_jaxprs,
+                    load_manifest, manifest_rel, trace_entry)
+
+# consts at or above this size are "giant": they re-upload with every
+# recompile, bloat the serialized executable, and defeat donation
+# (256 KiB ~ a [64k] f32 buffer; real model/feature data must be an
+# ARGUMENT, which is also what keeps the trace shape-generic)
+GIANT_CONST_BYTES = 256 * 1024
+
+# primitives that re-enter the host from device code: each one is a
+# synchronization point that de-pipelines dispatch (and is outright
+# unsupported inside a donated serving program)
+CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+}
+
+NARROW_ACC_DTYPES = {"int8", "int16", "uint8", "uint16", "float16",
+                     "bfloat16"}
+
+
+class IRRule(Rule):
+    """Base for jaxpr-level rules: selected only by `--ir` (or by
+    name), driven by run_ir_pass — never by the per-file AST loop."""
+    ir = True
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        # the shared trace/dispatch lives in run_ir_pass; a direct
+        # check() call (legacy path) just runs the full pass filtered
+        # to this rule
+        findings, _n, _sigs = run_ir_pass(ctx, rule_names=[self.name])
+        return findings
+
+    def check_entry(self, ctx: LintContext, rel: str, entry,
+                    closed) -> List[Finding]:
+        raise NotImplementedError
+
+
+def _f(rule: str, rel: str, entry, message: str) -> Finding:
+    return Finding(rule=rule, path=rel, line=entry.line, col=0,
+                   message=f"[{entry.name}] {message}")
+
+
+@register
+class NoF64(IRRule):
+    name = "ir-no-f64"
+    description = ("no float64 primitive, convert or constant in a hot "
+                   "entry's jaxpr (weak-type f64 promotion is a latent "
+                   "10-20x TPU slowdown invisible in source)")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        out: List[Finding] = []
+        flagged = set()
+        for c in closed.consts:
+            dt = str(getattr(c, "dtype", ""))
+            if dt == "float64" and "const" not in flagged:
+                flagged.add("const")
+                shape = tuple(getattr(c, "shape", ()))
+                out.append(_f(self.name, rel, entry,
+                              f"float64 constant {shape} baked into the "
+                              "program (a host-side numpy float64 "
+                              "literal/array captured by the trace; "
+                              "give it an explicit float32 dtype)"))
+        for eq in iter_eqns(closed):
+            in_f64 = any(dtype_name(v) == "float64" for v in eq.invars)
+            intro = [v for v in eq.outvars
+                     if dtype_name(v) == "float64"] if not in_f64 else []
+            if intro and eq.primitive.name not in flagged:
+                flagged.add(eq.primitive.name)
+                out.append(_f(self.name, rel, entry,
+                              f"primitive '{eq.primitive.name}' "
+                              "introduces float64 into device code "
+                              "(weak-type promotion from a float64 "
+                              "host value; under x64 the whole "
+                              "downstream program double-widths)"))
+        return out
+
+
+@register
+class NoCallback(IRRule):
+    name = "ir-no-callback"
+    description = ("no host callback / host transfer primitive inside "
+                   "a hot jitted entry (each is a device->host sync "
+                   "that de-pipelines dispatch)")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        out: List[Finding] = []
+        flagged = set()
+        for eq in iter_eqns(closed):
+            p = eq.primitive.name
+            if p in CALLBACK_PRIMS and p not in flagged:
+                flagged.add(p)
+                detail = ""
+                cb = eq.params.get("callback")
+                if cb is not None:
+                    detail = f" ({cb!r})"
+                out.append(_f(self.name, rel, entry,
+                              f"host callback primitive '{p}'{detail} "
+                              "inside the hot entry — every dispatch "
+                              "round-trips the host; move it outside "
+                              "the jitted program"))
+        return out
+
+
+def _kind(dt: str) -> str:
+    if dt.startswith("float") or dt.startswith("bfloat"):
+        return "f"
+    if dt.startswith("int") or dt.startswith("uint"):
+        return "i"
+    return dt
+
+
+def _itemsize(dt: str) -> int:
+    import numpy as np
+    try:
+        return np.dtype(dt).itemsize
+    except TypeError:
+        return 2 if dt == "bfloat16" else 4
+
+
+@register
+class ConvertChurn(IRRule):
+    name = "ir-convert-churn"
+    description = ("no convert_element_type round trip (A->B->A with "
+                   "no intervening compute, B at least as wide as A) — "
+                   "pure HBM traffic; the guard rail for the "
+                   "quantized-gradient work")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        out: List[Finding] = []
+        flagged = set()
+        for j in iter_jaxprs(closed):
+            producer: Dict[int, object] = {}
+            for eq in j.eqns:
+                for v in eq.outvars:
+                    producer[id(v)] = eq
+            for eq in j.eqns:
+                if eq.primitive.name != "convert_element_type":
+                    continue
+                src = eq.invars[0]
+                prev = producer.get(id(src))
+                if prev is None or \
+                        prev.primitive.name != "convert_element_type":
+                    continue
+                a = dtype_name(prev.invars[0])
+                b = dtype_name(src)
+                c = dtype_name(eq.outvars[0])
+                if a is None or b is None or c != a:
+                    continue
+                # A->B->A through a NARROWER B is a deliberate
+                # precision squeeze (bf16/int8 quantization); through a
+                # same-or-wider same-kind B it is pure churn.  A kind
+                # change (f->i) is value-truncating, i.e. semantic.
+                if _kind(a) == _kind(b) and \
+                        _itemsize(b) >= _itemsize(a):
+                    key = (a, b)
+                    if key not in flagged:
+                        flagged.add(key)
+                        out.append(_f(
+                            self.name, rel, entry,
+                            f"convert round trip {a} -> {b} -> {a} "
+                            "with no intervening compute — two "
+                            "full-array HBM passes for nothing"))
+        return out
+
+
+@register
+class GiantConstant(IRRule):
+    name = "ir-giant-constant"
+    description = (f"no constant >= {GIANT_CONST_BYTES // 1024} KiB "
+                   "baked into a hot entry's jaxpr (re-uploaded per "
+                   "recompile, bloats the executable; pass it as an "
+                   "argument)")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        out: List[Finding] = []
+        for c in closed.consts:
+            nbytes = getattr(c, "nbytes", 0)
+            if nbytes >= GIANT_CONST_BYTES:
+                shape = tuple(getattr(c, "shape", ()))
+                dt = getattr(c, "dtype", "?")
+                out.append(_f(
+                    self.name, rel, entry,
+                    f"{nbytes / 1024:.0f} KiB constant {shape} {dt} "
+                    "baked into the program — closed-over device data "
+                    "recompiles into every executable and occupies "
+                    "HBM per trace; pass it as an explicit argument"))
+        return out
+
+
+def _onehot_operand(j, eq) -> bool:
+    """True when one operand of a dot_general derives (through
+    convert/broadcast/transpose/reshape) from eq(iota, x) — the XLA
+    one-hot histogram trick."""
+    producer = {}
+    for e in j.eqns:
+        for v in e.outvars:
+            producer[id(v)] = e
+    PASS = {"convert_element_type", "broadcast_in_dim", "transpose",
+            "reshape", "squeeze"}
+    for opnd in eq.invars[:2]:
+        e, hops = producer.get(id(opnd)), 0
+        while e is not None and e.primitive.name in PASS and hops < 4:
+            e = producer.get(id(e.invars[0]))
+            hops += 1
+        if e is not None and e.primitive.name == "eq":
+            for v in e.invars:
+                pe = producer.get(id(v))
+                while pe is not None and pe.primitive.name in PASS:
+                    pe = producer.get(id(pe.invars[0]))
+                if pe is not None and pe.primitive.name == "iota":
+                    return True
+    return False
+
+
+@register
+class ScatterAudit(IRRule):
+    name = "ir-scatter-audit"
+    description = ("histogram-path shape audit: one-hot x dot "
+                   "histograms and sub-32-bit scatter accumulators "
+                   "must be DECLARED on their manifest entry "
+                   "('onehot-dot' / 'narrow-acc')")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        out: List[Finding] = []
+        declares = getattr(entry, "declares", frozenset())
+        saw_onehot = saw_narrow = False
+        for j in iter_jaxprs(closed):
+            for eq in j.eqns:
+                p = eq.primitive.name
+                if p == "dot_general" and not saw_onehot \
+                        and "onehot-dot" not in declares \
+                        and _onehot_operand(j, eq):
+                    saw_onehot = True
+                    out.append(_f(
+                        self.name, rel, entry,
+                        "undeclared one-hot x dot histogram shape "
+                        "(materializes the [n, bins] one-hot in HBM; "
+                        "the Pallas histogram kernel replaces it — "
+                        "declare 'onehot-dot' on the entry if this "
+                        "engine variant is meant to use it)"))
+                if p in ("scatter-add", "scatter_add") and not saw_narrow \
+                        and "narrow-acc" not in declares:
+                    acc = dtype_name(eq.invars[0]) or ""
+                    if acc in NARROW_ACC_DTYPES:
+                        saw_narrow = True
+                        out.append(_f(
+                            self.name, rel, entry,
+                            f"undeclared {acc} scatter accumulator — "
+                            "sub-32-bit histogram entries overflow "
+                            "silently; declare 'narrow-acc' if this is "
+                            "the deliberate quantized path"))
+        return out
+
+
+@register
+class TraceError(IRRule):
+    name = "ir-trace-error"
+    description = ("the IR audit could trace every manifest entry "
+                   "(reports manifest import / builder / trace "
+                   "failures — a hot entry the audit cannot see is "
+                   "itself a finding)")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        return []  # emitted by run_ir_pass, not per traced entry
+
+
+@register
+class ManifestCoverage(IRRule):
+    name = "ir-manifest-coverage"
+    description = ("every RecompileDetector-wrapped hot entry has a "
+                   "manifest row in _lint_entries.py (anchored at the "
+                   "detector construction site)")
+
+    def check_entry(self, ctx, rel, entry, closed):
+        return []  # emitted by run_ir_pass from the AST detector scan
+
+
+def detector_sites(ctx: LintContext) -> List[Tuple[str, int, str]]:
+    """(rel_path, line, group) for every RecompileDetector(...) call in
+    the package whose name argument is a (possibly f-string) literal —
+    the same names the cost model groups by (costmodel.group_of)."""
+    sites: List[Tuple[str, int, str]] = []
+    for pf in ctx.files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name != "RecompileDetector":
+                continue
+            arg = node.args[1]
+            head: Optional[str] = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                head = arg.value
+            elif isinstance(arg, ast.JoinedStr) and arg.values:
+                first = arg.values[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str):
+                    head = first.value
+            if head:
+                group = head.split("[", 1)[0]
+                sites.append((pf.rel, node.lineno, group))
+    return sites
+
+
+def run_ir_pass(ctx: LintContext,
+                rule_names: Optional[List[str]] = None,
+                groups: Optional[List[str]] = None
+                ) -> Tuple[List[Finding], int, Dict[str, str]]:
+    """Load the manifest, trace each entry ONCE, and dispatch the named
+    ir rules over the traced jaxprs.  Returns (findings, num_traced,
+    {entry name: exemplar signature hash}) — the signatures key the
+    per-entry result cache (core._ir_findings_and_section).  `groups`
+    restricts tracing to the named detector groups (bench.py audits
+    only the entries a run actually compiled)."""
+    from ..core import RULES
+    if rule_names is None:
+        rule_names = [n for n in RULES if getattr(RULES[n], "ir", False)]
+    mf_rel = manifest_rel(ctx)
+    entries, err = load_manifest(ctx.package_dir)
+    if err is not None:
+        return [Finding(rule="ir-trace-error", path=mf_rel, line=1,
+                        col=0, message=err)], 0, {}
+    findings: List[Finding] = []
+    if "ir-manifest-coverage" in rule_names:
+        covered = {e.group for e in entries}
+        seen = set()
+        for rel, line, group in detector_sites(ctx):
+            if group in covered or group in seen:
+                continue
+            seen.add(group)
+            findings.append(Finding(
+                rule="ir-manifest-coverage", path=rel, line=line, col=0,
+                message=f"hot entry group '{group}' is "
+                        "RecompileDetector-fingerprinted at runtime but "
+                        f"has no entry in {mf_rel} — the IR audit "
+                        "cannot see it"))
+    per_entry_rules = [RULES[n] for n in rule_names
+                       if n not in ("ir-manifest-coverage",
+                                    "ir-trace-error")]
+    num_traced = 0
+    sigs: Dict[str, str] = {}
+    for entry in entries:
+        if groups is not None and entry.group not in groups:
+            continue
+        closed, sig, err = trace_entry(entry)
+        if err is not None:
+            if "ir-trace-error" in rule_names:
+                findings.append(Finding(
+                    rule="ir-trace-error", path=mf_rel,
+                    line=getattr(entry, "line", 1), col=0,
+                    message=f"[{entry.name}] {err}"))
+            continue
+        num_traced += 1
+        sigs[entry.name] = sig
+        for rule in per_entry_rules:
+            findings.extend(rule.check_entry(ctx, mf_rel, entry, closed))
+    return findings, num_traced, sigs

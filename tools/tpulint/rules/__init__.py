@@ -21,3 +21,7 @@ from . import shape_taint     # noqa: F401
 from . import signal_safety   # noqa: F401
 from . import spmd            # noqa: F401
 from . import thread_safety   # noqa: F401
+# jaxpr-level rules (ISSUE 12): registered alongside the AST rules so
+# --list-rules/SARIF see them, but selected only by --ir (or by name) —
+# registration imports nothing heavy (jax loads lazily at trace time)
+from ..ir import rules as _ir_rules  # noqa: F401

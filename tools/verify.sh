@@ -21,11 +21,20 @@ python -m tools.tpulint lightgbm_tpu --baseline .tpulint_baseline.json \
 step "tpulint suppression audit"
 python -m tools.tpulint lightgbm_tpu --list-suppressions || fail=1
 
+step "tpulint IR audit (--ir: jaxpr-level, docs/StaticAnalysis.md v4)"
+ir_t0=$SECONDS
+JAX_PLATFORMS=cpu python -m tools.tpulint lightgbm_tpu --ir \
+    --baseline .tpulint_baseline.json || fail=1
+echo "ir-audit wall: $((SECONDS - ir_t0))s (cold ~5 s / warm <1 s, vs ~2 s cold AST lint)"
+
 step "config-doc sync (docs/Parameters.md)"
 python tools/gen_params_doc.py --check || fail=1
 
 step "event-doc sync (docs/Observability.md event table)"
 python tools/check_event_docs.py || fail=1
+
+step "fallback-matrix sync (docs/Inference.md host-fallback matrix)"
+python tools/check_fallback_docs.py || fail=1
 
 step "elastic chaos drill (tests/test_elastic.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow' \
