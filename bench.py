@@ -443,6 +443,337 @@ def serve_main(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def _parse_fleet_faults(smoke: bool) -> dict:
+    """BENCH_FLEET_FAULT=replica_crash@N,serve_slow@N,serve_shed@N,
+    canary_diverge@N — the fleet bench's chaos spec.  replica_crash /
+    serve_slow / serve_shed become LGBM_TPU_FAULT specs injected into a
+    replica's environment (@N = that replica's N-th accepted request);
+    canary_diverge@N is a bench-level drill: once N client requests
+    have succeeded, publish a deliberately-divergent model as a canary
+    and demand the auto-rollback.  The default (smoke included) drills
+    one crash, one shed, and one divergent canary."""
+    raw = os.environ.get("BENCH_FLEET_FAULT")
+    if raw is None:
+        raw = "replica_crash@25,serve_shed@10,canary_diverge@120"
+    out = {"replica_crash": None, "serve_slow": None,
+           "serve_shed": None, "canary_diverge": None}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        kind, _, n = tok.partition("@")
+        if kind in out and n.lstrip("-").isdigit():
+            out[kind] = int(n)
+        else:
+            print(f"[bench] WARNING: ignoring malformed "
+                  f"BENCH_FLEET_FAULT spec {tok!r}", file=sys.stderr)
+    return out
+
+
+def serve_fleet_main(smoke: bool = False) -> int:
+    """Fleet serving bench (ISSUE 13): `python bench.py --serve-fleet`.
+
+    Spawns K replica daemons + the retry/shed/canary router, drives
+    closed-loop client threads THROUGH the router, and chaos-drills the
+    fault domain mid-load (BENCH_FLEET_FAULT): one replica crashes and
+    is relaunched, one replica sheds, a rolling publish swaps every
+    replica to v2, and a deliberately-divergent canary must AUTO-ROLL
+    BACK.  Gates (rc != 0 on violation): ZERO failed client requests
+    through all of it, every response byte-identical to
+    `Booster.predict` of the version that served it, the
+    `serve_rollback`/`serve_shed` counters present on the router's
+    /metrics page, and every replica draining to rc 143 on SIGTERM."""
+    backend_fallback = _ensure_jax_backend()
+    import jax
+    if backend_fallback:
+        jax.config.update("jax_platforms", "cpu")
+    _backend_guard()
+
+    import tempfile
+    import threading
+    import urllib.request
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.observability.registry import global_registry
+    from lightgbm_tpu.serving import OverloadedError, ReplicaFleet, Router
+    from lightgbm_tpu.serving.daemon import serve_counters_reset
+
+    faults = _parse_fleet_faults(smoke)
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS",
+                                  2 if smoke else 3))
+    n_threads = int(os.environ.get("BENCH_FLEET_THREADS",
+                                   6 if smoke else 12))
+    req_rows = int(os.environ.get("BENCH_FLEET_REQ_ROWS", 4))
+    target_requests = int(os.environ.get(
+        "BENCH_FLEET_REQUESTS", 400 if smoke else 4000))
+
+    # model trio: v2 continues the workload (the GOOD publish); the
+    # canary candidate is trained with a pathological class weight so
+    # its score distribution visibly diverges — the auto-rollback bait
+    Xtr, ytr = make_higgs_like(20_000, FEATURES, seed=7)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20, "device_predict": "true",
+              "device_predict_min_bucket": 64}
+    b1 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=20)
+    b2 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=40)
+    b_bad = lgb.train({**params, "scale_pos_weight": 100.0},
+                      lgb.Dataset(Xtr, label=ytr), num_boost_round=10)
+
+    workdir = tempfile.mkdtemp(prefix="lgbm-fleet-bench-")
+    paths = {}
+    for tag, bst in (("v1", b1), ("v2", b2), ("bad", b_bad)):
+        paths[tag] = os.path.join(workdir, f"model_{tag}.txt")
+        bst.save_model(paths[tag])
+
+    pool, _ = make_higgs_like(2048, FEATURES, seed=8)
+    pool = np.ascontiguousarray(pool, np.float32)
+    # byte-identity oracle: every routed response must equal ONE
+    # version's Booster.predict rows exactly (versions are per-replica
+    # registry counters, so the SCORES identify the model, and a row
+    # mix of two versions inside one response can never match any)
+    expected = {tag: b.predict(pool)
+                for tag, b in (("v1", b1), ("v2", b2), ("bad", b_bad))}
+
+    serve_counters_reset()
+    victim = 1 % replicas
+    fault_envs = {}
+    specs = []
+    if faults["replica_crash"] is not None:
+        specs.append((victim, f"serve_crash@{faults['replica_crash']}"))
+    if faults["serve_shed"] is not None:
+        specs.append((0, f"serve_shed@{faults['serve_shed']}"))
+    if faults["serve_slow"] is not None:
+        specs.append((0, f"serve_slow@{faults['serve_slow']}"))
+    for idx, spec in specs:
+        env = fault_envs.setdefault(idx, {})
+        env["LGBM_TPU_FAULT"] = ",".join(
+            filter(None, [env.get("LGBM_TPU_FAULT"), spec]))
+
+    serve_params = {"device_predict": "true",
+                    "device_predict_min_bucket": 64,
+                    "serve_max_batch_rows": 256,
+                    "serve_max_coalesce_wait_ms": 2.0,
+                    "serve_queue_depth": 256,
+                    "verbosity": -1}
+    cfg = Config({**serve_params,
+                  "serve_replicas": replicas,
+                  "serve_retry_max": 4,
+                  "serve_retry_backoff_ms": 25.0,
+                  "serve_request_timeout_s": 60.0,
+                  "serve_canary_pct": 50.0,
+                  "serve_canary_min_samples": 24,
+                  "serve_canary_max_divergence": 2.0,
+                  "serve_canary_max_error_rate": 0.2})
+    fleet = ReplicaFleet(
+        num_replicas=replicas, model_entries=[("higgs", paths["v1"])],
+        workdir=workdir, params=serve_params,
+        max_restarts=3, health_interval_s=0.25, force_cpu=True,
+        fault_envs=fault_envs).start()
+    router = Router(fleet, cfg)
+    router.register_incumbent("higgs", paths["v1"])
+    failures: list = []
+    latencies: list = []
+    lat_lock = threading.Lock()
+    ok_count = [0]
+    overload_rejections = [0]
+    rows_served = [0]
+    versions_matched: set = set()
+    stop_flag = threading.Event()
+    try:
+        if not fleet.wait_ready(timeout=420.0):
+            print(json.dumps({"metric": "serve_fleet", "value": None,
+                              "error": "fleet never became ready",
+                              "replicas": fleet.describe()}))
+            return 1
+
+        def match_version(out_rows, start):
+            for tag, exp in expected.items():
+                if np.array_equal(out_rows, exp[start:start + req_rows]):
+                    return tag
+            return None
+
+        def client(tid: int) -> None:
+            rnd = 0
+            while not stop_flag.is_set():
+                rnd += 1
+                start = ((tid * 2654435761 + rnd * 97)
+                         % (len(pool) - req_rows))
+                try:
+                    r = router.predict("higgs",
+                                       pool[start:start + req_rows],
+                                       deadline_ms=45_000.0)
+                except OverloadedError:
+                    # an explicit admission rejection is the correct
+                    # answer from a saturated fleet, not a lost request
+                    # — the client backs off; the gate bounds the RATE
+                    with lat_lock:
+                        overload_rejections[0] += 1
+                    time.sleep(0.1)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    with lat_lock:
+                        failures.append(f"t{tid}r{rnd}: {e!r}")
+                    time.sleep(0.05)  # no failure-storm spinning
+                    continue
+                tag = match_version(np.asarray(r.preds), start)
+                with lat_lock:
+                    latencies.append(r.latency_ms)
+                    rows_served[0] += req_rows
+                    ok_count[0] += 1
+                    if tag is None:
+                        failures.append(
+                            f"t{tid}r{rnd}: response matches NO "
+                            f"version byte-for-byte (v{r.version} "
+                            f"replica {r.replica})")
+                    else:
+                        versions_matched.add(tag)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+
+        def done_fraction() -> float:
+            with lat_lock:
+                return ok_count[0] / max(target_requests, 1)
+
+        def wait_until(frac: float, timeout: float = 420.0) -> None:
+            deadline = time.time() + timeout
+            while done_fraction() < frac and time.time() < deadline:
+                time.sleep(0.05)
+
+        # phase A: plain load; the crash + shed faults fire in here
+        wait_until(0.35)
+        # phase B: rolling publish v2 (no canary) under live load —
+        # after the crashed replica rejoined, so the roll covers the
+        # whole fleet (a replica skipped mid-restart would relaunch
+        # onto the new version anyway via fleet.set_model_path)
+        fleet.wait_ready(timeout=180.0)
+        publish_info = router.publish("higgs", paths["v2"], canary_pct=0)
+        # phase C: wait for the canary threshold, then drop the bait
+        canary_at = faults["canary_diverge"]
+        rollback_ok = None
+        if canary_at is not None:
+            while done_fraction() * target_requests < canary_at and \
+                    time.time() - t0 < 420.0:
+                time.sleep(0.05)
+            fleet.wait_ready(timeout=120.0, min_replicas=2)
+            router.publish("higgs", paths["bad"])  # serve_canary_pct=50
+            verdict = router.canary_wait("higgs", timeout=240.0)
+            rollback_ok = verdict == "rolled_back"
+        wait_until(1.0)
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=120.0)
+        wall = time.time() - t0
+
+        # /metrics gate: the router's scrape page must carry the fleet
+        # counters the acceptance names (serve_rollback, serve_shed)
+        router.start_frontend(port=0, metrics_port=0)
+        metrics_scrape_ok = False
+        scrape_error = None
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{router.metrics_server.port}/metrics",
+                timeout=30).read().decode()
+            required = ["lgbm_router_requests", "lgbm_router_rows",
+                        "lgbm_serve_shed", "lgbm_router_p99_ms",
+                        "lgbm_fleet_replicas_routable"]
+            if rollback_ok is not None:
+                required.append("lgbm_serve_rollback")
+            missing = [r for r in required if r not in page]
+            malformed = [ln for ln in page.splitlines()
+                         if ln and not ln.startswith("#")
+                         and len(ln.rsplit(" ", 1)) != 2]
+            if missing:
+                scrape_error = f"missing series: {missing}"
+            elif malformed:
+                scrape_error = f"malformed lines: {malformed[:3]}"
+            else:
+                metrics_scrape_ok = True
+        except Exception as e:  # noqa: BLE001 - reported in the JSON line
+            scrape_error = str(e)
+
+        # one TCP round trip through the router wire (clients above ran
+        # in-process; the wire is what a real fleet client sees)
+        wire_ok = False
+        try:
+            import socket
+            port = router.frontend.server_address[1]
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=30) as s:
+                f = s.makefile("rwb")
+                f.write((json.dumps({"model": "higgs",
+                                     "rows": pool[:req_rows].tolist()})
+                         + "\n").encode())
+                f.flush()
+                resp = json.loads(f.readline())
+            wire_ok = bool(resp.get("ok")) and match_version(
+                np.asarray(resp["preds"]), 0) is not None
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"wire: {e!r}")
+
+        stats = router.stats()
+        crashes = int(global_registry.counter("serve_replica_down"))
+        restarts = int(global_registry.counter("serve_replica_restarts"))
+    finally:
+        stop_flag.set()
+        rcs = fleet.stop(drain=True, timeout=60.0)
+        router.stop()
+    drain_ok = all(rc in (143, -15) for rc in rcs.values())
+
+    lat = np.asarray(latencies, np.float64)
+    crash_wanted = faults["replica_crash"] is not None
+    out = {
+        "metric": "serve_fleet",
+        "value": round(float(np.percentile(lat, 99)), 3)
+        if len(lat) else None,
+        "unit": "p99_ms",
+        "fleet_p50_ms": round(float(np.percentile(lat, 50)), 3)
+        if len(lat) else None,
+        "fleet_p99_ms": round(float(np.percentile(lat, 99)), 3)
+        if len(lat) else None,
+        "fleet_rows_per_s": round(rows_served[0] / max(wall, 1e-9), 1),
+        "fleet_requests_per_s": round(len(lat) / max(wall, 1e-9), 1),
+        "replicas": replicas,
+        "requests_ok": int(ok_count[0]),
+        "requests_failed": len(failures),
+        "overload_rejections": int(overload_rejections[0]),
+        "replica_crashes": crashes,
+        "replica_restarts": restarts,
+        "router_retries": int(stats["router_retries"]),
+        "serve_shed": int(stats["serve_shed"]),
+        "serve_overloaded": int(stats["serve_overloaded"]),
+        "publishes": int(stats["serve_publish"]),
+        "rollback_ok": rollback_ok,
+        "serve_rollback": int(stats["serve_rollback"]),
+        "versions_matched": sorted(versions_matched),
+        "publish_rolled_replicas": sorted(
+            publish_info.get("replicas", {})) if publish_info else None,
+        "metrics_scrape_ok": bool(metrics_scrape_ok),
+        "metrics_scrape_error": scrape_error,
+        "wire_ok": bool(wire_ok),
+        "drain_returncodes": {str(k): v for k, v in sorted(rcs.items())},
+        "drain_ok": bool(drain_ok),
+        "errors": failures[:5],
+        "fault_spec": {k: v for k, v in faults.items() if v is not None},
+        "backend": jax.default_backend(),
+        "smoke": bool(smoke),
+    }
+    print(json.dumps(out))
+    ok = (not failures
+          and ok_count[0] >= target_requests
+          and overload_rejections[0] <= 0.05 * max(ok_count[0], 1)
+          and (not crash_wanted or (crashes >= 1 and restarts >= 1))
+          and int(stats["serve_publish"]) >= 1
+          and {"v1", "v2"} <= versions_matched
+          and (rollback_ok is None or rollback_ok)
+          and metrics_scrape_ok and wire_ok and drain_ok)
+    return 0 if ok else 1
+
+
 _MULTICHIP_CHILD = r"""
 import os, sys
 sys.path.insert(0, os.environ["BENCH_REPO"])
@@ -890,4 +1221,6 @@ if __name__ == "__main__":
         sys.exit(multichip_main(n))
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve":
         sys.exit(serve_main(smoke="--smoke" in sys.argv[2:]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--serve-fleet":
+        sys.exit(serve_fleet_main(smoke="--smoke" in sys.argv[2:]))
     main()

@@ -197,7 +197,23 @@ def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
     daemon.install_signal_handlers()
     srv = None
     if cfg.serve_port >= 0:
-        srv = start_frontend(daemon, port=cfg.serve_port)
+        srv = start_frontend(daemon, port=cfg.serve_port,
+                             request_timeout_s=cfg.serve_request_timeout_s)
+    if cfg.serve_ready_file:
+        # readiness marker for the fleet supervisor: port + pid land
+        # atomically only AFTER every model is loaded, warmed, and the
+        # front end is listening — a torn or early file would route
+        # traffic into cold compiles
+        import json as _json
+
+        from .utils import atomic_write_text
+        atomic_write_text(cfg.serve_ready_file, _json.dumps({
+            "pid": os.getpid(),
+            "port": srv.server_address[1] if srv is not None else -1,
+            "metrics_port": (daemon.metrics_server.port
+                             if daemon.metrics_server else -1),
+            "models": daemon.registry.versions()}))
+        log.info(f"Ready file written to {cfg.serve_ready_file}")
     log.info(f"Serving {len(entries)} model(s); SIGTERM drains and exits")
     try:
         while not daemon.stopped:
@@ -208,6 +224,97 @@ def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
     finally:
         if srv is not None:
             srv.shutdown()
+
+
+def _task_serve_fleet(cfg: Config, params: Dict[str, str]) -> None:
+    """Serving fault domain (docs/Serving.md fleet section):
+    `python -m lightgbm_tpu serve-fleet serve_models=m=model.txt
+    serve_replicas=3 serve_port=0`.  Spawns `serve_replicas` replica
+    daemons (each a supervised task=serve child with its own device
+    context and ready file), health-checks them, and fronts them with
+    the retry/shed/canary router on `serve_port`.  SIGTERM drains the
+    WHOLE fleet: the router stops accepting, every replica gets its own
+    SIGTERM drain (each exits 143), and the runner re-delivers — exit
+    stays 143."""
+    import tempfile
+    import time as _time
+
+    from .serving import ReplicaFleet, Router
+
+    if cfg.metrics_dir:
+        from .observability import set_event_logger
+        from .observability.events import EventLogger
+        set_event_logger(EventLogger(cfg.metrics_dir,
+                                     rotate_mb=cfg.metrics_rotate_mb))
+    entries = []
+    for tok in cfg.serve_models:
+        name, sep, path = tok.partition("=")
+        if not sep:
+            name, path = os.path.splitext(os.path.basename(tok))[0], tok
+        entries.append((name.strip(), path.strip()))
+    if not entries and cfg.input_model:
+        entries.append(("default", cfg.input_model))
+    if not entries:
+        log.fatal("task=serve-fleet needs serve_models=name=model.txt"
+                  "[,...] or input_model=<file>")
+    workdir = cfg.metrics_dir or tempfile.mkdtemp(prefix="lgbm-fleet-")
+    # replica daemons inherit the serving knobs; their OWN ports are
+    # ephemeral (the ready file reports them) and the router owns the
+    # client-facing serve_port
+    replica_params = {k: v for k, v in params.items()
+                      if k not in ("task", "serve_port", "serve_replicas",
+                                   "serve_ready_file", "metrics_dir",
+                                   "metrics_port")}
+    fleet = ReplicaFleet(
+        num_replicas=cfg.serve_replicas, model_entries=entries,
+        workdir=workdir, params=replica_params,
+        max_restarts=cfg.serve_max_replica_restarts,
+        health_interval_s=cfg.serve_health_interval_s,
+        force_cpu=os.environ.get("LGBM_TPU_SERVE_FORCE_CPU") == "1",
+    ).start()
+    router = Router(fleet, cfg)
+    for name, path in entries:
+        router.register_incumbent(name, path)
+    if not fleet.wait_ready(timeout=300.0, min_replicas=1):
+        fleet.stop(drain=False)
+        log.fatal("serve-fleet: no replica became ready within 300 s "
+                  f"(see {workdir}/replica-*.log)")
+    srv = router.start_frontend(port=max(cfg.serve_port, 0),
+                                metrics_port=cfg.metrics_port)
+    log.info(f"Fleet router listening on "
+             f"{srv.server_address[0]}:{srv.server_address[1]} "
+             f"({cfg.serve_replicas} replicas); SIGTERM drains the fleet")
+    if cfg.serve_ready_file:
+        import json as _json
+
+        from .utils import atomic_write_text
+        atomic_write_text(cfg.serve_ready_file, _json.dumps({
+            "pid": os.getpid(), "port": srv.server_address[1],
+            "metrics_port": (router.metrics_server.port
+                             if router.metrics_server else -1),
+            "replicas": fleet.describe()}))
+    stopping = {"flag": False}
+
+    def _drain():
+        stopping["flag"] = True
+        router.stop()
+        fleet.stop(drain=True, timeout=cfg.serve_drain_timeout_s + 30.0)
+        return None  # finish_preemption re-delivers; rc stays 143
+
+    from .observability import install_sigterm_flush, set_preemption_hook
+    if install_sigterm_flush():
+        set_preemption_hook(_drain)
+    try:
+        while not stopping["flag"] and fleet.alive():
+            _time.sleep(0.2)
+        if not stopping["flag"]:
+            log.warning("serve-fleet: every replica exhausted its "
+                        "restart budget; shutting down")
+    except KeyboardInterrupt:
+        log.info("Interrupted; draining the fleet")
+        _drain()
+    finally:
+        router.stop()
 
 
 def _task_convert_model(cfg: Config, params: Dict[str, str]) -> None:
@@ -297,9 +404,9 @@ def _maybe_init_distributed(cfg: Config) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] == "serve":
-        # `python -m lightgbm_tpu serve k=v ...` sugar for task=serve
-        argv = ["task=serve"] + list(argv[1:])
+    if argv and argv[0] in ("serve", "serve-fleet"):
+        # `python -m lightgbm_tpu serve[-fleet] k=v ...` sugar
+        argv = [f"task={argv[0]}"] + list(argv[1:])
     params = parse_args(argv)
     cfg = Config(dict(params))
     _maybe_init_distributed(cfg)
@@ -309,6 +416,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "refit_tree": _task_refit,
                 "save_binary": _task_save_binary,
                 "serve": _task_serve,
+                "serve-fleet": _task_serve_fleet,
+                "serve_fleet": _task_serve_fleet,
                 "convert_model": _task_convert_model}
     if task not in handlers:
         log.fatal(f"Unknown task {task!r}")

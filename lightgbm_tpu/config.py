@@ -319,6 +319,37 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # its enqueue->coalesce->dispatch->device-settle->respond stage
     # timestamps into the bounded trace ring (0 = off)
     ("serve_trace_sample", "int", 64, ("trace_sample",)),
+    # per-request wait bound on the TCP front end when the caller sends
+    # no deadline_ms of its own (was a hard-coded 60.0)
+    ("serve_request_timeout_s", "float", 60.0, ("request_timeout_s",)),
+    # --- serving fleet (docs/Serving.md fleet section) ---
+    # replica daemons the serve-fleet task spawns behind the router
+    ("serve_replicas", "int", 2, ("num_replicas",)),
+    # relaunch budget PER replica: a crashed replica restarts with
+    # exponential backoff until the budget runs out, then stays down
+    ("serve_max_replica_restarts", "int", 3, ()),
+    # fleet health-probe cadence (op=health: readiness + shed state)
+    ("serve_health_interval_s", "float", 0.5, ()),
+    # router retry budget per request: connection errors, timeouts and
+    # sheds retry on a DIFFERENT replica up to this many times
+    ("serve_retry_max", "int", 3, ()),
+    # base of the router's exponential retry backoff (doubles per
+    # retry, always bounded by the request's remaining deadline)
+    ("serve_retry_backoff_ms", "float", 25.0, ()),
+    # canary rollout: share of a model's traffic routed to the
+    # candidate replica during publish (0 = plain rolling publish)
+    ("serve_canary_pct", "float", 0.0, ("canary_pct",)),
+    # observations per arm before the canary verdict is allowed
+    ("serve_canary_min_samples", "int", 64, ()),
+    # auto-rollback when the canary's mean score drifts more than this
+    # many incumbent sigmas from the incumbent's mean
+    ("serve_canary_max_divergence", "float", 4.0, ()),
+    # auto-rollback when the canary arm's error rate exceeds this
+    ("serve_canary_max_error_rate", "float", 0.1, ()),
+    # task=serve writes {"port", "pid", "metrics_port", "models"} here
+    # once every model is warmed and the front end is listening — the
+    # fleet supervisor discovers replica ports through it
+    ("serve_ready_file", "str", "", ()),
     ("start_iteration_predict", "int", 0, ()),
     ("num_iteration_predict", "int", -1, ()),
     ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),

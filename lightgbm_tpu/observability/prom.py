@@ -55,12 +55,15 @@ def _fmt(value) -> str:
 
 
 def render_prometheus(registry=None, daemon=None, prefix: str = "lgbm_",
-                      extra_gauges: Optional[Dict[str, float]] = None
-                      ) -> str:
+                      extra_gauges: Optional[Dict[str, float]] = None,
+                      gauges_cb=None) -> str:
     """One Prometheus text page: registry counters/gauges (+ labelled
     `name::label` series), serving latency quantiles / queue depth /
     per-model state when a daemon is given, roofline aggregates when
-    the cost model is enabled, and any `extra_gauges`."""
+    the cost model is enabled, and any `extra_gauges`.  `gauges_cb` is
+    the LIVE form of extra_gauges — a zero-arg callable re-evaluated at
+    every scrape (the fleet router feeds its p50/p99 and replica-state
+    gauges through it; a static dict would freeze at registration)."""
     if registry is None:
         from .registry import global_registry
         registry = global_registry
@@ -122,7 +125,13 @@ def render_prometheus(registry=None, daemon=None, prefix: str = "lgbm_",
             if series:
                 emit_family(kind, f"{prefix}cost_{field}_total", series)
 
-    for name, value in sorted((extra_gauges or {}).items()):
+    live = dict(extra_gauges or {})
+    if gauges_cb is not None:
+        try:
+            live.update(gauges_cb() or {})
+        except Exception as e:  # noqa: BLE001 - a scrape must never kill serving
+            log.warning(f"/metrics: gauges_cb failed: {e}")
+    for name, value in sorted(live.items()):
         emit_family("gauge", _metric_name(name, prefix), [(None, value)])
     return "\n".join(lines) + "\n"
 
@@ -148,7 +157,8 @@ class _MetricsServer:
 
 def start_metrics_http(port: int = 0, host: str = "127.0.0.1",
                        daemon=None, registry=None,
-                       prefix: str = "lgbm_") -> Optional[_MetricsServer]:
+                       prefix: str = "lgbm_",
+                       gauges_cb=None) -> Optional[_MetricsServer]:
     """Bind `GET /metrics` (port 0 = ephemeral; read `server.port`) and
     serve on a background thread.  Returns None (with a warning) when
     the bind fails — a metrics port conflict must never block serving
@@ -162,7 +172,8 @@ def start_metrics_http(port: int = 0, host: str = "127.0.0.1",
                 return
             try:
                 body = render_prometheus(registry=registry, daemon=daemon,
-                                         prefix=prefix).encode()
+                                         prefix=prefix,
+                                         gauges_cb=gauges_cb).encode()
             except Exception as e:  # noqa: BLE001 - scrape must answer, not raise
                 self.send_error(500, str(e))
                 return

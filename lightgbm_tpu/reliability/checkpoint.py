@@ -69,6 +69,13 @@ _HASH_EXCLUDE = frozenset((
     "serve_models", "serve_max_coalesce_wait_ms", "serve_queue_depth",
     "serve_max_batch_rows", "serve_warmup", "serve_port",
     "serve_drain_timeout_s",
+    # serving-fleet knobs (docs/Serving.md fleet section): router /
+    # replica / canary topology, likewise model-neutral
+    "serve_request_timeout_s", "serve_replicas",
+    "serve_max_replica_restarts", "serve_health_interval_s",
+    "serve_retry_max", "serve_retry_backoff_ms", "serve_canary_pct",
+    "serve_canary_min_samples", "serve_canary_max_divergence",
+    "serve_canary_max_error_rate", "serve_ready_file",
     # the degradation ladder (reliability/guard.py) flips these between
     # attempts; all are model-neutral perf/telemetry knobs, and a
     # degraded relaunch MUST still resume the interrupted checkpoint

@@ -28,6 +28,27 @@ the fault).  Kinds:
   tombstone and refuses to start, so only an elastic shrink (smaller
   world, different tombstone key) recovers the run
 
+Serve-side fault points (docs/Reliability.md serving fault domain):
+the serving daemon ticks a per-process REQUEST counter at submit and
+the `@N` in these specs matches it — "the N-th request this replica
+accepts" — instead of a boosting iteration.  The fleet bench and
+tests drill the router's retry/shed/restart paths with them:
+
+* `serve_crash@N`     — `os._exit(CRASH_EXIT_CODE)` when request N is
+  submitted: the replica dies with requests in flight, the fleet
+  supervisor must relaunch it and the router must retry elsewhere
+* `serve_shed@N`      — force the queue-full path for request N: the
+  daemon raises the structured `shed` error exactly as if the bounded
+  queue were full
+* `serve_slow@N`      — arm a `LGBM_TPU_FAULT_SLOW_S` (default 2.0)
+  sleep consumed by the coalescer IMMEDIATELY BEFORE its next
+  dispatch: latency injection on the dispatcher thread, the shape a
+  wedged device presents to the frontend (queue backs up -> shed)
+
+Rank gating applies to replicas too: the fleet sets
+`LGBM_TPU_FAULT_SELF_RANK` to each replica's index, so
+`LGBM_TPU_FAULT_RANK=1` drills exactly one replica of a fleet.
+
 `LGBM_TPU_FAULT_RANK` (optional) restricts firing to one worker: it is
 compared against `LGBM_TPU_FAULT_SELF_RANK`, which the distributed worker
 main sets to its own rank (unset processes count as rank 0).
@@ -57,7 +78,8 @@ _specs: Optional[List[Tuple[str, int, int]]] = None
 
 _KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail",
           "hang", "slow_iter", "collective_stall",
-          "ckpt_corrupt", "worker_lost")
+          "ckpt_corrupt", "worker_lost",
+          "serve_crash", "serve_shed", "serve_slow")
 
 
 def _parse() -> List[Tuple[str, int, int]]:
@@ -86,9 +108,12 @@ def _parse() -> List[Tuple[str, int, int]]:
 
 def reload() -> None:
     """Re-read LGBM_TPU_FAULT (tests change the env mid-process)."""
-    global _specs
+    global _specs, _serve_requests, _serve_slow_pending
     # tpulint: disable-next=thread-shared-state -- test-only injection state: both sides rebind the same env-derived value, a duplicate parse is idempotent, and one-shot firing tolerates the benign GIL-serialized race
     _specs = None
+    _serve_requests = 0
+    # tpulint: disable-next=thread-shared-state -- test-only reset racing the dispatcher's consume: a GIL-atomic float rebind either side of the reset, worst case one injected sleep is dropped or kept — acceptable for an injection drill
+    _serve_slow_pending = 0.0
 
 
 def active() -> bool:
@@ -181,6 +206,66 @@ def maybe_collective_stall(iteration: int) -> None:
     if _should_fire("collective_stall", iteration):
         _record_injection("collective_stall", iteration)
         _wedge("collective_stall", iteration)
+
+
+# serve-side fault state: the daemon ticks `_serve_requests` once per
+# accepted request (under its own submit path, GIL-serialized int adds;
+# the off-by-one a torn increment could cause is acceptable for an
+# injection drill), and serve_slow arms a sleep the coalescer consumes
+# just before its next dispatch
+_serve_requests = 0
+_serve_slow_pending = 0.0
+
+
+def serve_request_tick() -> int:
+    """Count one accepted serving request; returns the 1-based request
+    index this process has seen (the `@N` the serve_* specs match)."""
+    global _serve_requests
+    _serve_requests += 1
+    return _serve_requests
+
+
+def maybe_serve_crash(request_n: int) -> None:
+    """serve_crash hook (daemon submit path): replica dies mid-load."""
+    if _should_fire("serve_crash", request_n):
+        _record_injection("serve_crash", request_n)
+        sys.stderr.write(f"[LGBM_TPU_FAULT] injected serve_crash at "
+                         f"request {request_n}: exiting "
+                         f"{CRASH_EXIT_CODE}\n")
+        sys.stderr.flush()
+        os._exit(CRASH_EXIT_CODE)
+
+
+def maybe_serve_shed(request_n: int) -> bool:
+    """serve_shed hook: True = treat this submit as queue-full and fail
+    fast with the structured shed error (coalescer.ShedError)."""
+    if _should_fire("serve_shed", request_n):
+        _record_injection("serve_shed", request_n)
+        log.warning(f"[LGBM_TPU_FAULT] injecting serve_shed at request "
+                    f"{request_n}: forcing the queue-full path")
+        return True
+    return False
+
+
+def maybe_serve_slow(request_n: int) -> None:
+    """serve_slow hook (submit path): arm the dispatcher-side sleep."""
+    global _serve_slow_pending
+    if _should_fire("serve_slow", request_n):
+        _record_injection("serve_slow", request_n)
+        dur = float(os.environ.get("LGBM_TPU_FAULT_SLOW_S", "2.0"))
+        log.warning(f"[LGBM_TPU_FAULT] arming serve_slow at request "
+                    f"{request_n}: next dispatch sleeps {dur:.1f}s")
+        _serve_slow_pending = dur
+
+
+def consume_serve_slow() -> None:
+    """Dispatcher-side half of serve_slow: sleep the armed duration
+    once, immediately before the next coalesced dispatch."""
+    global _serve_slow_pending
+    dur, _serve_slow_pending = _serve_slow_pending, 0.0
+    if dur > 0:
+        import time
+        time.sleep(dur)
 
 
 def register_stack_dump_signal() -> bool:

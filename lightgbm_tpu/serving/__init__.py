@@ -1,22 +1,34 @@
-"""Persistent multi-model serving daemon (docs/Serving.md).
+"""Persistent multi-model serving daemon + replica fleet
+(docs/Serving.md).
 
 The "millions of users" layer over the device inference stack: a
 long-lived process that owns the device and composes the compiled
 bucket ladder (inference/), a hot-swap model registry (registry.py),
 and a request coalescer (coalescer.py) into sustained throughput with
-bounded tail latency.  `python -m lightgbm_tpu serve` is the CLI front
-end; `ServingClient` the in-process API; `bench.py --serve` the
-closed-loop p50/p99 bench.
+bounded tail latency — and, above it, the serving FAULT DOMAIN
+(ISSUE 13): K replica daemons under poll-based supervision (fleet.py)
+behind a router (router.py) that retries across replicas with deadline
+propagation, sheds load when the fleet saturates, and rolls new model
+versions out replica-by-replica with canary auto-rollback.
+`python -m lightgbm_tpu serve` / `serve-fleet` are the CLI front ends;
+`ServingClient` the in-process/TCP API; `bench.py --serve` /
+`--serve-fleet` the closed-loop benches.
 """
 
-from .coalescer import Coalescer, ServeFuture, ServeRequest
+from .coalescer import Coalescer, ServeFuture, ServeRequest, ShedError
 from .daemon import ServingClient, ServingDaemon, serve_counters_reset
-from .frontend import ServeFrontend, start_frontend
+from .fleet import ReplicaEndpoint, ReplicaFleet, ReplicaState
+from .frontend import LineClient, ServeFrontend, start_frontend
 from .registry import LoadHandle, ModelEntry, ModelRegistry
+from .router import (NoReplicaError, OverloadedError, Router, RouterReply,
+                     start_router_frontend)
 
 __all__ = [
-    "Coalescer", "ServeFuture", "ServeRequest",
+    "Coalescer", "ServeFuture", "ServeRequest", "ShedError",
     "ServingClient", "ServingDaemon", "serve_counters_reset",
-    "ServeFrontend", "start_frontend",
+    "ReplicaEndpoint", "ReplicaFleet", "ReplicaState",
+    "LineClient", "ServeFrontend", "start_frontend",
     "LoadHandle", "ModelEntry", "ModelRegistry",
+    "NoReplicaError", "OverloadedError", "Router", "RouterReply",
+    "start_router_frontend",
 ]

@@ -14,11 +14,20 @@ Invariants the tests pin:
 * order/identity — responses are row-slices of the request's own rows;
   grouping keys include the model ENTRY (a specific version acquired at
   submit), so a hot swap can never cross-wire rows between versions;
-* bounded queue — a slow device backpressures submitters (`submit`
-  blocks) instead of buffering unboundedly;
+* bounded queue, shed fast — a full queue FAILS the submit immediately
+  with the structured `ShedError` instead of blocking the submitter
+  (ISSUE 13): a blocked frontend thread turns one slow replica into a
+  stalled fleet, while a structured shed lets the router retry the
+  request on another replica within its deadline.  `serve_shed` counts
+  every shed and `last_shed_age_s()` feeds the health probe's
+  `shedding` flag so the fleet admission controller can reject before
+  even trying;
 * drain — `stop(drain=True)` completes every queued request before the
-  thread exits (the SIGTERM path), and failed dispatches park the error
-  on every affected future rather than killing the thread.
+  thread exits (the SIGTERM path), failed dispatches park the error on
+  every affected future rather than killing the thread, and requests
+  the drain DEADLINE abandons are counted and announced with one
+  `serve_drain_abandoned` event (sync write path — stop() runs from
+  the SIGTERM hook) instead of disappearing silently.
 """
 
 from __future__ import annotations
@@ -34,6 +43,19 @@ from ..observability.flightrec import flight_recorder
 from ..observability.registry import LatencyWindow, global_registry
 from ..utils import log
 from ..utils.timer import global_timer
+
+
+class ShedError(RuntimeError):
+    """Structured load-shed rejection: the replica's bounded queue is
+    full (or a serve_shed fault forced the path), so this submit failed
+    FAST instead of blocking.  Idempotent predicts make a retry on a
+    different replica safe — the router does exactly that, and answers
+    `overloaded` only once every replica sheds."""
+
+    def __init__(self, message: str, pending: int = 0, depth: int = 0):
+        super().__init__(message)
+        self.pending = int(pending)
+        self.depth = int(depth)
 
 
 class ServeFuture:
@@ -117,15 +139,25 @@ class Coalescer:
         self._trace_sample = max(int(trace_sample), 0)
         self._req_seq = 0
         self._stop = threading.Event()
+        # set when the drain deadline has passed (or drain was not
+        # requested): the dispatcher must NOT start another batch —
+        # whatever is still queued gets failed as abandoned
+        self._abandon = threading.Event()
         self._lock = threading.Lock()
         self._closing = False
         self._thread: Optional[threading.Thread] = None
+        # monotonic stamp of the most recent shed; the health probe's
+        # `shedding` flag reads it through last_shed_age_s()
+        self._last_shed: Optional[float] = None
+        # requests failed by the most recent drain deadline (stop())
+        self.last_abandoned = 0
 
     # -------------------------------------------------------------- control
     def start(self) -> None:
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._stop.clear()
+                self._abandon.clear()
                 self._closing = False
                 self._thread = threading.Thread(
                     target=self._loop, name="lgbm-serve-coalescer",
@@ -139,14 +171,37 @@ class Coalescer:
         return t is not None and t.is_alive()
 
     def submit(self, req: ServeRequest) -> None:
-        """Queue one request (blocks when the bounded queue is full —
-        backpressure, exactly like the AsyncWriter)."""
+        """Queue one request; a FULL queue sheds (raises ShedError)
+        instead of blocking — fail fast so the router can retry on
+        another replica while the deadline still has budget."""
         with self._lock:
             closing = self._closing or self._thread is None
         if closing:
             raise RuntimeError("Serving daemon is not accepting requests "
                                "(stopped or draining)")
-        self._q.put(req)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.shed(reason="queue full")
+
+    def shed(self, reason: str = "queue full") -> None:
+        """Record one load shed and raise the structured ShedError (the
+        serve_shed fault point calls this to force the path)."""
+        with self._lock:
+            self._last_shed = time.monotonic()
+        global_registry.inc("serve_shed")
+        raise ShedError(
+            f"request shed: {reason} "
+            f"({self._q.qsize()}/{self._q.maxsize} queued); retry on "
+            "another replica", pending=self._q.qsize(),
+            depth=self._q.maxsize)
+
+    def last_shed_age_s(self) -> Optional[float]:
+        """Seconds since the most recent shed, None when never shed —
+        the health probe's `shedding` flag is `age < window`."""
+        with self._lock:
+            last = self._last_shed
+        return None if last is None else time.monotonic() - last
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> bool:
@@ -167,6 +222,10 @@ class Coalescer:
                     drained = self._q.unfinished_tasks == 0
                     break
                 time.sleep(0.005)
+        # past this point nothing more may dispatch: a missed drain
+        # deadline (or drain=False) means the remaining queue is
+        # ABANDONED, not quietly served during the thread join below
+        self._abandon.set()
         self._stop.set()
         with self._lock:
             t = self._thread
@@ -175,7 +234,9 @@ class Coalescer:
             # bounded: the dispatcher pops with a 50 ms timeout and
             # re-checks the stop event, so this join is capped
             t.join(timeout=10.0)
-        # fail whatever the drain deadline abandoned
+        # fail whatever the drain deadline abandoned — and SAY SO: a
+        # preemption drain that quietly dropped queued requests would
+        # read as a clean exit in the event log (ISSUE 13 satellite)
         leftovers: List[ServeRequest] = []
         while True:
             try:
@@ -187,6 +248,21 @@ class Coalescer:
                                                "before dispatch"))
             req.entry.release()
             self._q.task_done()
+        self.last_abandoned = len(leftovers)
+        if leftovers:
+            global_registry.inc("serve_drain_abandoned", len(leftovers))
+            # sync write path: stop() runs from the SIGTERM preemption
+            # hook, where the AsyncWriter may be exactly what is stuck
+            # (the PR-9 terminal-event rule)
+            from ..observability.events import emit_event_sync
+            try:
+                emit_event_sync("serve_drain_abandoned",
+                                abandoned=len(leftovers),
+                                timeout_s=timeout)
+            except Exception:  # noqa: BLE001 - telemetry must not block the exit
+                pass
+            log.warning(f"Serving drain abandoned {len(leftovers)} queued "
+                        f"request(s) at the {timeout}s deadline")
         return drained and not leftovers
 
     @property
@@ -196,6 +272,8 @@ class Coalescer:
     # --------------------------------------------------------------- worker
     def _loop(self) -> None:
         while True:
+            if self._abandon.is_set():
+                return  # stop() fails the remaining queue itself
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:
@@ -228,6 +306,13 @@ class Coalescer:
                     batch.append(nxt)
                     rows += nxt.n
             try:
+                # serve_slow fault point: latency injected on the
+                # dispatcher thread, just before the dispatch — the
+                # queue keeps filling behind it (docs/Reliability.md).
+                # Unconditional (not behind active()): the one-shot spec
+                # already fired at submit, arming the pending sleep.
+                from ..reliability import faults
+                faults.consume_serve_slow()
                 self._dispatch(batch)
             finally:
                 for _ in batch:

@@ -168,6 +168,11 @@ class ServingDaemon:
             emit_event_sync(
                 "serve_drain", pending_at_signal=int(pending),
                 drained=bool(drained),
+                # a missed drain deadline abandons queued requests; the
+                # count rides the terminal event (and its own
+                # serve_drain_abandoned event from coalescer.stop) so
+                # rc=143 with drained=false is diagnosable
+                abandoned=int(self.coalescer.last_abandoned),
                 requests=int(global_registry.counter("serve_requests")))
         except Exception:  # noqa: BLE001 - dying anyway; flush next
             pass
@@ -181,6 +186,15 @@ class ServingDaemon:
         poison a coalesced bucket or force a fresh trace."""
         if mode not in _MODES:
             raise ValueError(f"mode must be one of {_MODES} (got {mode!r})")
+        from ..reliability import faults
+        if faults.active():
+            # serve-side fault points (docs/Reliability.md): @N matches
+            # the per-process request counter ticked here
+            n = faults.serve_request_tick()
+            faults.maybe_serve_crash(n)
+            if faults.maybe_serve_shed(n):
+                self.coalescer.shed(reason="serve_shed fault injected")
+            faults.maybe_serve_slow(n)
         rows = _as_f32_rows(X)
         entry = self.registry.get(model)   # acquired; release on response
         try:
@@ -202,6 +216,36 @@ class ServingDaemon:
         """Blocking convenience wrapper over submit()."""
         return self.submit(model, X, mode=mode).result(timeout=timeout)
 
+    # --------------------------------------------------------------- health
+    # a shed inside this window marks the replica `shedding` on the
+    # health probe, so the router's admission controller can reject
+    # fleet-wide BEFORE burning a round trip on a replica that just shed
+    _SHED_WINDOW_S = 1.0
+
+    def health(self) -> Dict[str, object]:
+        """Readiness + load state for the fleet health probe
+        (`op=health`): `ready` means every registered model finished its
+        load AND its warmup ledger (a replica serving cold would pay
+        compiles on live traffic), `shedding` means the bounded queue
+        shed within the last second — the router skips shedding
+        replicas and answers `overloaded` once all of them are."""
+        shed_age = self.coalescer.last_shed_age_s()
+        pending = self.coalescer.pending
+        return {
+            "ready": (not self._stopped.is_set()
+                      and self.registry.ready()),
+            "models": {n: v for n, v in self.registry.versions().items()},
+            "pending": pending,
+            # a shed counts as CURRENT pressure only while the queue is
+            # still backed up — one isolated shed followed by a drained
+            # queue must not advertise saturation for a whole window
+            "shedding": (shed_age is not None
+                         and shed_age < self._SHED_WINDOW_S
+                         and pending > 0),
+            "stopped": self._stopped.is_set(),
+            "pid": os.getpid(),
+        }
+
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, object]:
         p50, p99 = self.latency.percentiles((50.0, 99.0))
@@ -212,6 +256,7 @@ class ServingDaemon:
             "serve_dispatches": global_registry.counter("serve_dispatches"),
             "serve_errors": global_registry.counter("serve_errors"),
             "serve_swaps": global_registry.counter("serve_swaps"),
+            "serve_shed": global_registry.counter("serve_shed"),
             "serve_p50_ms": p50,
             "serve_p99_ms": p99,
             "queue_pending": self.coalescer.pending,
@@ -247,26 +292,112 @@ class ServingDaemon:
 
 
 class ServingClient:
-    """In-process client handle for a ServingDaemon — the API surface a
-    front end (socket, RPC) would wrap.  Thread-safe: any number of
-    client threads may call concurrently (that is the point)."""
+    """Client handle for a serving daemon — in-process (wrap the
+    `ServingDaemon` directly) or remote over the line-JSON TCP wire
+    (`ServingClient.connect(host, port)`).
 
-    def __init__(self, daemon: ServingDaemon):
+    The in-process form is thread-safe: any number of client threads
+    may call concurrently (that is the point).  The TCP form owns ONE
+    connection (the wire is one-request-one-response), serializes
+    calls behind a lock, and RECONNECTS with exponential backoff when
+    the connection drops — a replica restart no longer raises to the
+    caller on the next call (ISSUE 13 satellite).  `deadline_ms` rides
+    each request: in-process it bounds the future wait; over TCP it
+    propagates to the replica so the server gives up when the client
+    has."""
+
+    def __init__(self, daemon: Optional[ServingDaemon] = None,
+                 address: Optional[Tuple[str, int]] = None,
+                 request_timeout_s: float = 60.0,
+                 retry_backoff_ms: float = 25.0):
+        if (daemon is None) == (address is None):
+            raise ValueError("ServingClient needs exactly one of daemon= "
+                             "(in-process) or address= (TCP)")
         self._daemon = daemon
+        self._conn = None
+        self._timeout_s = float(request_timeout_s)
+        if address is not None:
+            from .frontend import LineClient
+            self._conn = LineClient(address[0], int(address[1]),
+                                    backoff_ms=retry_backoff_ms)
+            self._conn_lock = threading.Lock()
 
+    @classmethod
+    def connect(cls, host: str, port: int,
+                request_timeout_s: float = 60.0,
+                retry_backoff_ms: float = 25.0) -> "ServingClient":
+        """TCP client for a daemon's front end (`serve_port`)."""
+        return cls(address=(host, port),
+                   request_timeout_s=request_timeout_s,
+                   retry_backoff_ms=retry_backoff_ms)
+
+    # ---------------------------------------------------------------- wire
+    def _request(self, msg: dict,
+                 timeout_s: Optional[float] = None) -> dict:
+        wait = self._timeout_s if timeout_s is None else timeout_s
+        with self._conn_lock:
+            try:
+                reply = self._conn.request(msg, timeout_s=wait)
+            except ConnectionError:
+                # the daemon restarted between calls (hot replica
+                # churn): reconnect-with-backoff and resend ONCE —
+                # predict/stats/health are idempotent
+                reply = self._conn.request(msg, timeout_s=wait)
+        if reply.get("ok"):
+            return reply
+        from .coalescer import ShedError
+        err = reply.get("error", "serving error")
+        if reply.get("shed"):
+            raise ShedError(err, pending=int(reply.get("pending", 0)))
+        if reply.get("timeout"):
+            raise TimeoutError(err)
+        raise RuntimeError(err)
+
+    # ----------------------------------------------------------------- API
     def predict(self, model: str, X, mode: str = "predict",
-                timeout: Optional[float] = None):
-        return self._daemon.predict(model, X, mode=mode, timeout=timeout)
+                timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None):
+        if self._daemon is not None:
+            if deadline_ms is not None:
+                t = float(deadline_ms) / 1000.0
+                timeout = t if timeout is None else min(timeout, t)
+            return self._daemon.predict(model, X, mode=mode,
+                                        timeout=timeout)
+        msg = {"model": model, "rows": np.asarray(X).tolist(),
+               "mode": mode}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        wait = timeout if timeout is not None else (
+            float(deadline_ms) / 1000.0 + 1.0
+            if deadline_ms is not None else None)
+        reply = self._request(msg, timeout_s=wait)
+        return np.asarray(reply["preds"])
 
     def predict_async(self, model: str, X,
                       mode: str = "predict") -> ServeFuture:
+        if self._daemon is None:
+            raise RuntimeError("predict_async is in-process only; the "
+                               "TCP wire is one-request-one-response")
         return self._daemon.submit(model, X, mode=mode)
 
     def models(self):
-        return self._daemon.registry.names()
+        if self._daemon is not None:
+            return self._daemon.registry.names()
+        return self._request({"op": "models"})["models"]
 
     def stats(self):
-        return self._daemon.stats()
+        if self._daemon is not None:
+            return self._daemon.stats()
+        return self._request({"op": "stats"})["stats"]
+
+    def health(self):
+        if self._daemon is not None:
+            return self._daemon.health()
+        return self._request({"op": "health"})
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
 
 
 def serve_counters_reset() -> None:

@@ -266,6 +266,28 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._models)
 
+    def versions(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: e.version for n, e in self._models.items()}
+
+    def ready(self) -> bool:
+        """Readiness for the fleet health probe: at least one model is
+        live and every live entry finished its warmup ledger (warmup
+        disabled counts as complete — the operator opted into cold
+        compiles).  A pending load blocks readiness only for a name
+        with NO live entry yet (cold start): a hot-swap publish keeps
+        the old version serving while the new one warms, so the
+        replica stays routable through the roll.  A replica is routed
+        to only when this is True, so live traffic never pays a load
+        or a ladder compile."""
+        with self._lock:
+            if not self._models:
+                return False
+            if any(name not in self._models for name in self._pending):
+                return False
+            return all((not self._warmup) or e.warmup_traces > 0
+                       for e in self._models.values())
+
     def serve_recompiles(self) -> int:
         """Traces compiled OUTSIDE warmup — 0 in a healthy steady state
         (every request size pads into a pre-compiled bucket)."""

@@ -1,0 +1,132 @@
+"""Stub replica for the fleet tests: speaks the serving wire protocol
+(predict/health/publish/metrics) with a deterministic linear "model",
+no jax, no lightgbm — so ReplicaFleet/Router supervision, routing,
+retry, shed, and canary logic get exercised against REAL processes and
+REAL sockets in milliseconds instead of daemon-startup seconds.
+
+Prediction contract: `preds[i] = sum(rows[i]) * scale`, where `scale`
+comes from the published model path — a path containing `scale<k>`
+serves with scale k (default 1).  `version` increments per publish,
+mirroring the real registry.  Env knobs:
+
+  STUB_READY_FILE  — ready-file path (written after bind, like the CLI)
+  STUB_WARMUP_S    — delay before health reports ready (default 0)
+  STUB_SHED        — 1: every predict answers a structured shed
+  STUB_SHED_HEALTH — 1: health probes ADVERTISE shedding (the
+                     admission-controller path; independent of
+                     STUB_SHED so retry-on-shed and reject-on-probe
+                     are testable separately)
+  STUB_CRASH_AFTER — os._exit(17) when request N arrives
+  STUB_SLOW_MS     — per-predict latency injection
+  STUB_SCALE       — initial model scale (default 1)
+
+SIGTERM exits 143 (the drained-daemon contract the fleet gate checks).
+"""
+
+import json
+import os
+import re
+import signal
+import socketserver
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    state = {
+        "version": 1,
+        "scale": float(os.environ.get("STUB_SCALE", "1")),
+        "requests": 0,
+        "ready_at": time.monotonic() + float(
+            os.environ.get("STUB_WARMUP_S", "0")),
+        "model": os.environ.get("STUB_MODEL", "m"),
+    }
+    lock = threading.Lock()
+    crash_after = int(os.environ.get("STUB_CRASH_AFTER", "0"))
+    slow_ms = float(os.environ.get("STUB_SLOW_MS", "0"))
+
+    class Handler(socketserver.StreamRequestHandler):
+        def _reply(self, obj):
+            self.wfile.write((json.dumps(obj) + "\n").encode())
+            self.wfile.flush()
+
+        def handle(self):
+            for line in self.rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                    op = msg.get("op", "predict")
+                    if op == "health":
+                        with lock:
+                            ready = time.monotonic() >= state["ready_at"]
+                            self._reply({
+                                "ok": True, "ready": ready,
+                                "models": {state["model"]:
+                                           state["version"]},
+                                "pending": 0,
+                                "shedding": os.environ.get(
+                                    "STUB_SHED_HEALTH") == "1",
+                                "pid": os.getpid()})
+                        continue
+                    if op == "publish":
+                        m = re.search(r"scale(\d+)", str(msg["path"]))
+                        with lock:
+                            state["scale"] = float(m.group(1)) if m else 1.0
+                            state["version"] += 1
+                            self._reply({"ok": True,
+                                         "version": state["version"]})
+                        continue
+                    if op == "stats":
+                        with lock:
+                            self._reply({"ok": True, "stats":
+                                         {"requests": state["requests"]}})
+                        continue
+                    if op == "metrics":
+                        self._reply({"ok": True, "metrics":
+                                     "# TYPE stub counter\nstub 1\n"})
+                        continue
+                    # predict
+                    with lock:
+                        state["requests"] += 1
+                        n = state["requests"]
+                        scale = state["scale"]
+                        version = state["version"]
+                    if crash_after and n >= crash_after:
+                        os._exit(17)
+                    if os.environ.get("STUB_SHED") == "1":
+                        self._reply({"ok": False, "shed": True,
+                                     "error": "stub shed", "pending": 0})
+                        continue
+                    if slow_ms:
+                        time.sleep(slow_ms / 1000.0)
+                    preds = [sum(r) * scale for r in msg["rows"]]
+                    self._reply({"ok": True, "version": version,
+                                 "latency_ms": 0.1, "preds": preds})
+                except Exception as e:  # noqa: BLE001 - per-line reply
+                    try:
+                        self._reply({"ok": False, "error": str(e)})
+                    except OSError:
+                        return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(143))
+    srv = Server(("127.0.0.1", 0), Handler)
+    ready_file = os.environ.get("STUB_READY_FILE")
+    if ready_file:
+        tmp = ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"port": srv.server_address[1],
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, ready_file)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,17 @@ step "serving suite (tests/test_serving.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
 
+step "fleet suite (tests/test_fleet.py)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
+
 step "serving bench smoke (bench.py --serve --smoke)"
 JAX_PLATFORMS=cpu python bench.py --serve --smoke || fail=1
+
+step "fleet bench smoke (bench.py --serve-fleet --smoke)"
+# gates: zero lost client requests under an injected replica crash +
+# rolling publish + canary auto-rollback, router counters on /metrics
+JAX_PLATFORMS=cpu python bench.py --serve-fleet --smoke || fail=1
 
 if [[ "${1:-}" != "--quick" ]]; then
     step "tier-1 (full suite, 870 s cap)"
