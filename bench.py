@@ -451,10 +451,14 @@ def _parse_fleet_faults(smoke: bool) -> dict:
     canary_diverge@N is a bench-level drill: once N client requests
     have succeeded, publish a deliberately-divergent model as a canary
     and demand the auto-rollback.  The default (smoke included) drills
-    one crash, one shed, and one divergent canary."""
+    one crash, one shed, one slow dispatcher (the SLO-burn bait: the
+    armed sleep stalls the dispatch loop, so every queued request
+    behind it breaches the latency SLO at once), and one divergent
+    canary."""
     raw = os.environ.get("BENCH_FLEET_FAULT")
     if raw is None:
-        raw = "replica_crash@25,serve_shed@10,canary_diverge@120"
+        raw = ("replica_crash@25,serve_shed@10,serve_slow@60,"
+               "canary_diverge@120")
     out = {"replica_crash": None, "serve_slow": None,
            "serve_shed": None, "canary_diverge": None}
     for tok in raw.split(","):
@@ -482,7 +486,14 @@ def serve_fleet_main(smoke: bool = False) -> int:
     through all of it, every response byte-identical to
     `Booster.predict` of the version that served it, the
     `serve_rollback`/`serve_shed` counters present on the router's
-    /metrics page, and every replica draining to rc 143 on SIGTERM."""
+    /metrics page, and every replica draining to rc 143 on SIGTERM.
+    ISSUE 14 adds the observability-plane gates: the router's merged
+    `lgbm_fleet_*` scrape must equal the sum of the per-replica scrapes
+    with BOTH replicas contributing, at least one sampled request must
+    assemble into a full cross-process trace (router route/attempt +
+    replica serve/queue/dispatch/respond spans, >= 2 pids, monotone
+    stamps), and the serve_slow dispatcher stall must fire >= 1
+    `slo_burn` (75 ms p99 SLO, shrunk burn windows)."""
     backend_fallback = _ensure_jax_backend()
     import jax
     if backend_fallback:
@@ -536,6 +547,8 @@ def serve_fleet_main(smoke: bool = False) -> int:
                 for tag, b in (("v1", b1), ("v2", b2), ("bad", b_bad))}
 
     serve_counters_reset()
+    for key in ("slo_burn_total", "router_requests", "router_rows"):
+        global_registry.inc(key, -global_registry.counter(key))
     victim = 1 % replicas
     fault_envs = {}
     specs = []
@@ -564,7 +577,20 @@ def serve_fleet_main(smoke: bool = False) -> int:
                   "serve_canary_pct": 50.0,
                   "serve_canary_min_samples": 24,
                   "serve_canary_max_divergence": 2.0,
-                  "serve_canary_max_error_rate": 0.2})
+                  "serve_canary_max_error_rate": 0.2,
+                  # cross-process tracing (ISSUE 14): sample every 16th
+                  # routed request so the smoke run assembles a few
+                  # dozen full client->router->replica->device traces
+                  "serve_trace_sample": 16,
+                  # SLO burn gate: normal container latency (p99 tens
+                  # of ms) stays inside budget; the serve_slow fault's
+                  # armed 2 s dispatcher stall pushes every queued
+                  # request over 75 ms at once and must burn BOTH
+                  # windows (shrunk so a smoke run spans several)
+                  "serve_slo_p99_ms": 75.0,
+                  "serve_slo_error_pct": 1.0,
+                  "serve_slo_fast_window_s": 2.0,
+                  "serve_slo_slow_window_s": 20.0})
     fleet = ReplicaFleet(
         num_replicas=replicas, model_entries=[("higgs", paths["v1"])],
         workdir=workdir, params=serve_params,
@@ -669,8 +695,59 @@ def serve_fleet_main(smoke: bool = False) -> int:
             t.join(timeout=120.0)
         wall = time.time() - t0
 
+        # --- fleet-aggregation gate (ISSUE 14): one forced synchronous
+        # scrape of every replica, then the router's MERGED counter must
+        # equal the sum of the per-replica scrapes exactly (traffic has
+        # stopped, so the counters are static) and BOTH replicas must
+        # have contributed a non-zero share
+        fleet.wait_ready(timeout=60.0)
+        fleet.scrape_all()
+        agg_snapshot = fleet.aggregator.snapshot()
+        per_replica_requests = {
+            idx: s["counters"].get("lgbm_serve_requests", 0.0)
+            for idx, s in sorted(agg_snapshot.items())}
+        merged_requests = fleet.aggregator.merged_counters().get(
+            "lgbm_serve_requests", 0.0)
+        fleet_metrics_ok = (
+            len(per_replica_requests) >= min(replicas, 2)
+            and all(v > 0 for v in per_replica_requests.values())
+            and abs(merged_requests
+                    - sum(per_replica_requests.values())) < 1e-9)
+
+        # --- assembled-trace gate (ISSUE 14): at least one sampled
+        # request produced a full cross-process waterfall — router
+        # routing (route/attempt), replica coalesce/dispatch
+        # (serve/queue/dispatch) and device settle (dispatch span end +
+        # respond span) — from >= 2 processes with monotone stamps
+        trace_ok = False
+        trace_seen = router.assembler.traces()
+        for tr in trace_seen:
+            if tr.get("outcome") != "ok":
+                continue
+            names = {s["name"] for s in tr["spans"]}
+            if not {"route", "attempt", "serve", "queue", "dispatch",
+                    "respond"} <= names:
+                continue
+            if len(tr.get("processes", ())) < 2:
+                continue
+            rels = [s["rel_ms"] for s in tr["spans"]]
+            if any(b < a for a, b in zip(rels, rels[1:])) \
+                    or any(r < 0 for r in rels):
+                continue
+            trace_ok = True
+            break
+
+        # --- SLO burn gate (ISSUE 14): the serve_slow fault's 2 s
+        # dispatcher stall breached the 75 ms latency SLO for every
+        # queued request at once; the router's multi-window burn-rate
+        # tracker must have fired at least one slo_burn
+        slo_burns = int(global_registry.counter("slo_burn_total"))
+        slo_wanted = faults["serve_slow"] is not None
+        slo_ok = (slo_burns >= 1) if slo_wanted else None
+
         # /metrics gate: the router's scrape page must carry the fleet
         # counters the acceptance names (serve_rollback, serve_shed)
+        # plus the merged fleet families and per-replica gauges
         router.start_frontend(port=0, metrics_port=0)
         metrics_scrape_ok = False
         scrape_error = None
@@ -680,17 +757,34 @@ def serve_fleet_main(smoke: bool = False) -> int:
                 timeout=30).read().decode()
             required = ["lgbm_router_requests", "lgbm_router_rows",
                         "lgbm_serve_shed", "lgbm_router_p99_ms",
-                        "lgbm_fleet_replicas_routable"]
+                        "lgbm_fleet_replicas_routable",
+                        "lgbm_fleet_serve_requests",
+                        'lgbm_fleet_replica_up{replica="0"}',
+                        'lgbm_fleet_replica_up{replica="1"}',
+                        "lgbm_fleet_latency_ms"]
             if rollback_ok is not None:
                 required.append("lgbm_serve_rollback")
+            if slo_wanted:
+                required.append("lgbm_fleet_slo_burning")
             missing = [r for r in required if r not in page]
             malformed = [ln for ln in page.splitlines()
                          if ln and not ln.startswith("#")
                          and len(ln.rsplit(" ", 1)) != 2]
+            page_fleet_requests = None
+            for ln in page.splitlines():
+                if ln.startswith("lgbm_fleet_serve_requests "):
+                    page_fleet_requests = float(ln.rsplit(" ", 1)[1])
             if missing:
                 scrape_error = f"missing series: {missing}"
             elif malformed:
                 scrape_error = f"malformed lines: {malformed[:3]}"
+            elif page_fleet_requests is not None and abs(
+                    page_fleet_requests
+                    - sum(per_replica_requests.values())) > 1e-9:
+                scrape_error = (
+                    f"merged lgbm_fleet_serve_requests "
+                    f"{page_fleet_requests} != per-replica sum "
+                    f"{sum(per_replica_requests.values())}")
             else:
                 metrics_scrape_ok = True
         except Exception as e:  # noqa: BLE001 - reported in the JSON line
@@ -754,6 +848,14 @@ def serve_fleet_main(smoke: bool = False) -> int:
             publish_info.get("replicas", {})) if publish_info else None,
         "metrics_scrape_ok": bool(metrics_scrape_ok),
         "metrics_scrape_error": scrape_error,
+        "fleet_metrics_ok": bool(fleet_metrics_ok),
+        "fleet_requests_per_replica": {
+            str(k): int(v) for k, v in per_replica_requests.items()},
+        "fleet_requests_merged": int(merged_requests),
+        "traces_assembled": len(trace_seen),
+        "trace_ok": bool(trace_ok),
+        "slo_burns": slo_burns,
+        "slo_ok": slo_ok,
         "wire_ok": bool(wire_ok),
         "drain_returncodes": {str(k): v for k, v in sorted(rcs.items())},
         "drain_ok": bool(drain_ok),
@@ -770,6 +872,8 @@ def serve_fleet_main(smoke: bool = False) -> int:
           and int(stats["serve_publish"]) >= 1
           and {"v1", "v2"} <= versions_matched
           and (rollback_ok is None or rollback_ok)
+          and fleet_metrics_ok and trace_ok
+          and (slo_ok is None or slo_ok)
           and metrics_scrape_ok and wire_ok and drain_ok)
     return 0 if ok else 1
 

@@ -284,6 +284,19 @@ def _task_serve_fleet(cfg: Config, params: Dict[str, str]) -> None:
     log.info(f"Fleet router listening on "
              f"{srv.server_address[0]}:{srv.server_address[1]} "
              f"({cfg.serve_replicas} replicas); SIGTERM drains the fleet")
+    if cfg.serve_slo_p99_ms > 0:
+        # router-observed SLO burn tracking (docs/Observability.md
+        # "Fleet metrics & SLO"): slo_burn events land in the event log
+        # when metrics_dir= is set, fleet_slo_burning rides /metrics
+        log.info(f"SLO tracking on: p99 <= {cfg.serve_slo_p99_ms:g} ms, "
+                 f"error budget {cfg.serve_slo_error_pct:g}% "
+                 f"(burn windows {cfg.serve_slo_fast_window_s:g}s / "
+                 f"{cfg.serve_slo_slow_window_s:g}s)")
+    if router.metrics_server is not None:
+        log.info(f"Fleet observability on port "
+                 f"{router.metrics_server.port}: GET /metrics (merged "
+                 f"fleet view) and GET /trace/<id> (sampled "
+                 f"cross-process waterfalls; op=trace on the wire)")
     if cfg.serve_ready_file:
         import json as _json
 

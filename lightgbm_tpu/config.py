@@ -350,6 +350,24 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # once every model is warmed and the front end is listening — the
     # fleet supervisor discovers replica ports through it
     ("serve_ready_file", "str", "", ()),
+    # --- fleet SLO tracking (docs/Observability.md "Fleet metrics &
+    # SLO"): router-observed request outcomes feed a multi-window
+    # burn-rate computation; both windows over threshold emits one
+    # structured `slo_burn` event and raises the `fleet_slo_burning`
+    # gauge until the burn clears ---
+    # latency SLO: a routed request counts AGAINST the error budget
+    # when it fails or takes longer than this (0 = SLO tracking off)
+    ("serve_slo_p99_ms", "float", 0.0, ()),
+    # error budget as a percentage of requests (1.0 = 99% of requests
+    # must succeed within the latency SLO)
+    ("serve_slo_error_pct", "float", 1.0, ()),
+    # burn-rate windows: the fast window catches an acute breach, the
+    # slow one filters out blips (both must burn to alert)
+    ("serve_slo_fast_window_s", "float", 60.0, ()),
+    ("serve_slo_slow_window_s", "float", 1800.0, ()),
+    # burning when window_bad_fraction / error_budget exceeds this in
+    # BOTH windows (1.0 = budget exhausted at the current rate)
+    ("serve_slo_burn_threshold", "float", 1.0, ()),
     ("start_iteration_predict", "int", 0, ()),
     ("num_iteration_predict", "int", -1, ()),
     ("predict_raw_score", "bool", False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),

@@ -105,6 +105,14 @@ class DevicePredictor:
         self._dev = None      # device copies of the pack arrays
         self._fns = {}        # (mode, bucket, F) -> RecompileDetector(jit)
         self._x_sharding = None
+        # most recent accounted dispatch's compiled-cost delta (flops /
+        # bytes / wall seconds / bucket) — the serving coalescer stamps
+        # it onto the request's dispatch SPAN so a trace says where the
+        # chip time went (docs/Observability.md "Distributed tracing");
+        # lock-guarded: the serving dispatcher writes, any thread reads
+        import threading
+        self._dispatch_lock = threading.Lock()
+        self._last_dispatch = None
 
     # ------------------------------------------------------------- device
     def _device_arrays(self):
@@ -240,7 +248,23 @@ class DevicePredictor:
                 global_registry.inc("device_predict_bytes", cost[1])
             global_registry.inc("device_predict_s", dt)
             global_registry.inc("device_predict_dispatches")
+            with self._dispatch_lock:
+                self._last_dispatch = {
+                    "flops": cost[0] if cost is not None else None,
+                    "bytes": cost[1] if cost is not None else None,
+                    "dispatch_s": round(dt, 6),
+                    "bucket": int(bucket),
+                }
         return host[:n], bucket
+
+    def last_dispatch_info(self):
+        """The most recent accounted dispatch's cost-model delta
+        (`{flops, bytes, dispatch_s, bucket}`), or None before any
+        accounted dispatch / with the cost model off — the serving
+        trace layer's dispatch-span attributes."""
+        with self._dispatch_lock:
+            info = self._last_dispatch
+            return dict(info) if info is not None else None
 
     def warmup(self, num_features: int, max_rows: int,
                modes=("convert", "raw"),

@@ -32,8 +32,11 @@ from .flightrec import (FlightRecorder, dump_flight_record,
                         flight_file_path, flight_recorder)
 from .hostio import (AsyncWriter, clear_preemption_hook, flush_host_io,
                      install_sigterm_flush, set_preemption_hook)
-from .prom import render_prometheus, start_metrics_http
+from .prom import (parse_prometheus_text, render_prometheus,
+                   start_metrics_http)
 from .registry import MetricsRegistry, global_registry, process_rank
+from .tracing import (SloTracker, SpanAssembler, TraceContext, make_span,
+                      new_span_id, new_trace_id)
 from .watchdog import (RecompileDetector, sample_device_memory,
                        update_memory_gauges)
 
@@ -46,6 +49,8 @@ __all__ = [
     "flush_host_io", "install_sigterm_flush",
     "set_preemption_hook", "clear_preemption_hook",
     "MetricsRegistry", "global_registry", "process_rank",
-    "render_prometheus", "start_metrics_http",
+    "parse_prometheus_text", "render_prometheus", "start_metrics_http",
+    "SloTracker", "SpanAssembler", "TraceContext", "make_span",
+    "new_span_id", "new_trace_id",
     "RecompileDetector", "sample_device_memory", "update_memory_gauges",
 ]

@@ -14,7 +14,12 @@ and per sampled request — no knob to forget):
   split included), recompile/HBM gauges, rows/s;
 * sampled per-request serving traces — trace id plus the
   enqueue -> coalesce -> dispatch -> device-settle -> respond stage
-  timestamps (param `serve_trace_sample`: every Nth request);
+  timestamps (param `serve_trace_sample`: every Nth request); in the
+  ROUTER process the same ring also holds `kind: "assembled_trace"`
+  summaries of the cross-process span waterfalls
+  (observability/tracing.py SpanAssembler) and replicas record
+  `kind: "dispatch_error"` entries carrying the failed requests'
+  trace ids, so a crash dump stays greppable by trace id;
 * a coalesce-batch-size histogram (power-of-two buckets, requests and
   rows) — the shape of the batching the wait-knob trade actually buys.
 

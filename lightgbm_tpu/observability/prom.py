@@ -54,16 +54,52 @@ def _fmt(value) -> str:
     return repr(f)
 
 
+def parse_prometheus_text(page: str) -> Dict[str, Dict[str, float]]:
+    """Inverse of `render_prometheus`, for the fleet aggregator: parse a
+    text-format page into `{"counters": {...}, "gauges": {...}}` keyed
+    by the FULL series name (labels included, e.g.
+    `lgbm_serve_requests_by_model{model="higgs"}`).  `# TYPE` lines
+    route each family to its kind; unparseable lines are skipped (a
+    replica mid-restart must never poison the merged view)."""
+    out: Dict[str, Dict[str, float]] = {"counters": {}, "gauges": {}}
+    kinds: Dict[str, str] = {}
+    for line in page.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        name, sep, value = line.rpartition(" ")
+        if not sep or not name:
+            continue
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        base = name.split("{", 1)[0]
+        table = out["counters"] if kinds.get(base) == "counter" \
+            else out["gauges"]
+        table[name] = val
+    return out
+
+
 def render_prometheus(registry=None, daemon=None, prefix: str = "lgbm_",
                       extra_gauges: Optional[Dict[str, float]] = None,
-                      gauges_cb=None) -> str:
+                      gauges_cb=None, text_cb=None) -> str:
     """One Prometheus text page: registry counters/gauges (+ labelled
     `name::label` series), serving latency quantiles / queue depth /
     per-model state when a daemon is given, roofline aggregates when
     the cost model is enabled, and any `extra_gauges`.  `gauges_cb` is
     the LIVE form of extra_gauges — a zero-arg callable re-evaluated at
     every scrape (the fleet router feeds its p50/p99 and replica-state
-    gauges through it; a static dict would freeze at registration)."""
+    gauges through it; a static dict would freeze at registration).
+    `text_cb` returns a pre-rendered text BLOCK appended verbatim —
+    the fleet aggregator renders its merged multi-replica families
+    through it (labelled series with non-`model` label keys, which the
+    `::label` counter folding cannot express)."""
     if registry is None:
         from .registry import global_registry
         registry = global_registry
@@ -133,6 +169,13 @@ def render_prometheus(registry=None, daemon=None, prefix: str = "lgbm_",
             log.warning(f"/metrics: gauges_cb failed: {e}")
     for name, value in sorted(live.items()):
         emit_family("gauge", _metric_name(name, prefix), [(None, value)])
+    if text_cb is not None:
+        try:
+            block = text_cb()
+            if block:
+                lines.append(str(block).rstrip("\n"))
+        except Exception as e:  # noqa: BLE001 - a scrape must never kill serving
+            log.warning(f"/metrics: text_cb failed: {e}")
     return "\n".join(lines) + "\n"
 
 
@@ -158,22 +201,51 @@ class _MetricsServer:
 def start_metrics_http(port: int = 0, host: str = "127.0.0.1",
                        daemon=None, registry=None,
                        prefix: str = "lgbm_",
-                       gauges_cb=None) -> Optional[_MetricsServer]:
+                       gauges_cb=None, text_cb=None,
+                       traces_cb=None) -> Optional[_MetricsServer]:
     """Bind `GET /metrics` (port 0 = ephemeral; read `server.port`) and
     serve on a background thread.  Returns None (with a warning) when
     the bind fails — a metrics port conflict must never block serving
-    or training."""
+    or training.  With `traces_cb` (a `trace_id_or_None -> dict|None`
+    callable, the router's SpanAssembler) the listener also answers
+    `GET /trace/<id>` — and bare `GET /trace` with the newest — as the
+    assembled cross-process waterfall JSON (docs/Observability.md
+    "Distributed tracing")."""
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
-            if self.path.split("?", 1)[0] != "/metrics":
-                self.send_error(404, "try /metrics")
+            path = self.path.split("?", 1)[0]
+            if traces_cb is not None and (path == "/trace"
+                                          or path.startswith("/trace/")):
+                trace_id = path[len("/trace/"):] or None \
+                    if path.startswith("/trace/") else None
+                try:
+                    trace = traces_cb(trace_id)
+                except Exception as e:  # noqa: BLE001 - debug surface must answer
+                    self.send_error(500, str(e))
+                    return
+                if trace is None:
+                    self.send_error(404, "no such trace (sampled out, "
+                                         "evicted, or never assembled)")
+                    return
+                body = _json.dumps(trace, indent=1, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path != "/metrics":
+                self.send_error(404, "try /metrics"
+                                + (" or /trace/<id>" if traces_cb else ""))
                 return
             try:
                 body = render_prometheus(registry=registry, daemon=daemon,
                                          prefix=prefix,
-                                         gauges_cb=gauges_cb).encode()
+                                         gauges_cb=gauges_cb,
+                                         text_cb=text_cb).encode()
             except Exception as e:  # noqa: BLE001 - scrape must answer, not raise
                 self.send_error(500, str(e))
                 return

@@ -414,6 +414,32 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         ok = ok & (gain > min_gain_shift)
         return jnp.where(ok, gain, K_MIN_SCORE)
 
+    # ---- canonical tie-break across empty-bin runs -------------------------
+    # Candidate thresholds separated only by EMPTY bins (zero accumulated
+    # grad/hess/count between them) induce the identical row partition; in
+    # the reference's sequential scan their left sums tie bit-exactly, so
+    # its strict `>` keeps the first-visited candidate (largest tau for
+    # REVERSE, smallest for forward).  jnp.cumsum is a TREE scan: the
+    # prefix sums at two such candidates can disagree in the last ulp, and
+    # which side the noise lands on depends on the summands — a serial and
+    # a psum'd (data-parallel) histogram can therefore flip the argmax
+    # between truly-tied thresholds (the test_parallel threshold
+    # "off-by-two").  Snap the winner to its run's canonical end; the
+    # partition is unchanged by construction, so only the float payload
+    # moves (by ulps).
+    nonempty = (grad != 0.0) | (hess != 0.0) | (cnt != 0)
+    last_ne = jax.lax.cummax(jnp.where(nonempty, bins, -1), axis=1)
+
+    def snap_over_empty(best_idx, gain_2d, up):
+        t0 = best_idx[:, None]
+        valid = gain_2d > K_MIN_SCORE  # candidate passed every gate
+        if up:
+            run = valid & (bins >= t0) & (last_ne <= t0)
+            return jnp.max(jnp.where(run, bins, t0), axis=1)
+        lo = jnp.take_along_axis(last_ne, t0, 1)  # last non-empty <= t0
+        run = valid & (bins <= t0) & (bins >= lo)
+        return jnp.min(jnp.where(run, bins, t0), axis=1)
+
     # ---- REVERSE scan: left = bins <= tau (+NaN, +zero-bin when default_left) ----
     # right side accumulates bins > tau; candidate at threshold tau = t-1
     # (ref: hpp:856-930), so left sums are the inclusive prefix at tau.
@@ -432,6 +458,7 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
     # tie-break: largest tau wins (scan visits from the right)
     rev_best_idx = (max_bin - 1
                     - jnp.argmax(rev_gain[:, ::-1], axis=1)).astype(jnp.int32)
+    rev_best_idx = snap_over_empty(rev_best_idx, rev_gain, up=True)
     rev_best_gain = jnp.take_along_axis(rev_gain, rev_best_idx[:, None], 1)[:, 0]
 
     # ---- FORWARD scan: left = inclusive prefix at tau; missing goes right ----
@@ -441,6 +468,7 @@ def find_best_split(hist: jnp.ndarray, num_bin: jnp.ndarray,
         fwd_tau_ok &= bins == rand_bin[:, None]
     fwd_gain = eval_candidates(pg, ph, pc, fwd_tau_ok)
     fwd_best_idx = jnp.argmax(fwd_gain, axis=1).astype(jnp.int32)
+    fwd_best_idx = snap_over_empty(fwd_best_idx, fwd_gain, up=False)
     fwd_best_gain = jnp.take_along_axis(fwd_gain, fwd_best_idx[:, None], 1)[:, 0]
 
     # forward replaces reverse only on strictly larger gain (ref: hpp:1031)

@@ -76,6 +76,9 @@ _HASH_EXCLUDE = frozenset((
     "serve_retry_max", "serve_retry_backoff_ms", "serve_canary_pct",
     "serve_canary_min_samples", "serve_canary_max_divergence",
     "serve_canary_max_error_rate", "serve_ready_file",
+    # fleet SLO / tracing knobs (docs/Observability.md): telemetry only
+    "serve_slo_p99_ms", "serve_slo_error_pct", "serve_slo_fast_window_s",
+    "serve_slo_slow_window_s", "serve_slo_burn_threshold",
     # the degradation ladder (reliability/guard.py) flips these between
     # attempts; all are model-neutral perf/telemetry knobs, and a
     # degraded relaunch MUST still resume the interrupted checkpoint

@@ -17,7 +17,8 @@ versions out replica-by-replica with canary auto-rollback.
 
 from .coalescer import Coalescer, ServeFuture, ServeRequest, ShedError
 from .daemon import ServingClient, ServingDaemon, serve_counters_reset
-from .fleet import ReplicaEndpoint, ReplicaFleet, ReplicaState
+from .fleet import (FleetAggregator, ReplicaEndpoint, ReplicaFleet,
+                    ReplicaState)
 from .frontend import LineClient, ServeFrontend, start_frontend
 from .registry import LoadHandle, ModelEntry, ModelRegistry
 from .router import (NoReplicaError, OverloadedError, Router, RouterReply,
@@ -26,7 +27,7 @@ from .router import (NoReplicaError, OverloadedError, Router, RouterReply,
 __all__ = [
     "Coalescer", "ServeFuture", "ServeRequest", "ShedError",
     "ServingClient", "ServingDaemon", "serve_counters_reset",
-    "ReplicaEndpoint", "ReplicaFleet", "ReplicaState",
+    "FleetAggregator", "ReplicaEndpoint", "ReplicaFleet", "ReplicaState",
     "LineClient", "ServeFrontend", "start_frontend",
     "LoadHandle", "ModelEntry", "ModelRegistry",
     "NoReplicaError", "OverloadedError", "Router", "RouterReply",

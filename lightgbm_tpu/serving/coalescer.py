@@ -41,6 +41,8 @@ import numpy as np
 
 from ..observability.flightrec import flight_recorder
 from ..observability.registry import LatencyWindow, global_registry
+from ..observability.tracing import (MAX_SPANS_PER_REQUEST, TraceContext,
+                                     make_span)
 from ..utils import log
 from ..utils.timer import global_timer
 
@@ -69,14 +71,16 @@ class ServeFuture:
         self._error: Optional[BaseException] = None
         self._version: Optional[int] = None
         self._latency_ms: Optional[float] = None
+        self._spans: Optional[List[dict]] = None
 
     def _set(self, result=None, error=None, version=None,
-             latency_ms=None) -> None:
+             latency_ms=None, spans=None) -> None:
         with self._lock:
             self._result = result
             self._error = error
             self._version = version
             self._latency_ms = latency_ms
+            self._spans = spans
         self._event.set()
 
     def done(self) -> bool:
@@ -101,13 +105,22 @@ class ServeFuture:
         with self._lock:
             return self._latency_ms
 
+    @property
+    def spans(self) -> Optional[List[dict]]:
+        """Completed child spans for a trace-sampled request (None
+        otherwise): the replica-side half of the cross-process waterfall
+        the response envelope carries back to the router."""
+        with self._lock:
+            return self._spans
+
 
 class ServeRequest:
     __slots__ = ("entry", "X", "mode", "n", "future", "t_submit",
-                 "early_stop", "t_coalesce")
+                 "early_stop", "t_coalesce", "trace")
 
     def __init__(self, entry, X: np.ndarray, mode: str,
-                 early_stop: Optional[Tuple[int, float]] = None):
+                 early_stop: Optional[Tuple[int, float]] = None,
+                 trace: Optional[TraceContext] = None):
         self.entry = entry
         self.X = X
         self.mode = mode
@@ -115,6 +128,11 @@ class ServeRequest:
         self.n = int(X.shape[0])
         self.future = ServeFuture()
         self.t_submit = time.monotonic()
+        # propagated trace context (docs/Observability.md "Distributed
+        # tracing"): when present its id correlates this request across
+        # processes; when additionally `sampled`, the dispatcher builds
+        # real child spans from the stage stamps below
+        self.trace = trace
         # stamped by the dispatcher when the request leaves the queue;
         # the flight recorder's stage breakdown reads it
         self.t_coalesce: Optional[float] = None
@@ -354,11 +372,14 @@ class Coalescer:
             # settled by here: t_settle - t_dispatch covers pad + H2D +
             # program + D2H for the whole fused group
             t_settle = time.monotonic()
+            span_map = self._group_spans(reqs, entry, mode, dp,
+                                         t_dispatch, t_settle)
             off = 0
             for r in reqs:
                 lat = (t_settle - r.t_submit) * 1000.0
                 r.future._set(result=out[off:off + r.n],
-                              version=entry.version, latency_ms=lat)
+                              version=entry.version, latency_ms=lat,
+                              spans=span_map.get(id(r)))
                 off += r.n
                 if self._window is not None:
                     self._window.record(lat)
@@ -380,14 +401,88 @@ class Coalescer:
                                 int(off))
             global_registry.inc("serve_dispatch_s", t_settle - t_dispatch)
         except Exception as e:  # noqa: BLE001 - a bad request must not kill the thread
+            trace_ids = sorted({r.trace.trace_id for r in reqs
+                                if r.trace is not None})
             log.warning(f"Serving dispatch failed for model "
-                        f"{entry.name!r} v{entry.version}: {e}")
+                        f"{entry.name!r} v{entry.version}: {e}"
+                        + (f" (traces: {', '.join(trace_ids)})"
+                           if trace_ids else ""))
             global_registry.inc("serve_errors", len(reqs))
+            if trace_ids:
+                # failures stay greppable by trace id in the flight
+                # recorder even when the client never reads the future
+                flight_recorder.record_trace(
+                    kind="dispatch_error", model=entry.name,
+                    version=entry.version, error=str(e)[:200],
+                    trace_ids=trace_ids)
             for r in reqs:
                 r.future._set(error=e)
         finally:
             for r in reqs:
                 r.entry.release()
+
+    @staticmethod
+    def _group_spans(reqs: List[ServeRequest], entry, mode: str, dp,
+                     t_dispatch: float, t_settle: float
+                     ) -> Dict[int, List[dict]]:
+        """Child spans for the trace-SAMPLED requests of one fused
+        dispatch: serve (submit->respond) wrapping queue
+        (enqueue->coalesce), dispatch (dispatch->device-settle) and
+        respond (settle->now).  The dispatch spans of all batch-mates
+        CROSS-LINK (span links, OpenTelemetry-style): one physical
+        device dispatch served N requests, and each request's waterfall
+        says so — plus how the chip time was spent (the PR-11
+        cost-model flop/byte delta of exactly this dispatch, stamped by
+        DevicePredictor at the dispatch site)."""
+        traced = [r for r in reqs if r.trace is not None
+                  and r.trace.sampled]
+        if not traced:
+            return {}
+        # wall-clock anchors derived from ONE time.time() read: spans
+        # are cross-process comparable, monotonic stamps stay the
+        # latency source of truth
+        m_now = time.monotonic()
+        w_now = time.time()
+
+        def wall(mono: Optional[float]) -> float:
+            return w_now - (m_now - (mono if mono is not None else m_now))
+
+        info = dp.last_dispatch_info() if hasattr(
+            dp, "last_dispatch_info") else None
+        group_rows = sum(r.n for r in reqs)
+        # span contexts first: links need every mate's dispatch span id
+        # before any span is finalized
+        serve_ctxs = {id(r): r.trace.child() for r in traced}
+        dispatch_ctx = {id(r): serve_ctxs[id(r)].child() for r in traced}
+        anon_mates = len(reqs) - len(traced)
+        out: Dict[int, List[dict]] = {}
+        for r in traced:
+            serve_ctx = serve_ctxs[id(r)]
+            d_ctx = dispatch_ctx[id(r)]
+            links = [{"trace_id": m.trace.trace_id,
+                      "span_id": dispatch_ctx[id(m)].span_id}
+                     for m in traced if m is not r]
+            links += [{"trace_id": m.trace.trace_id}
+                      for m in reqs
+                      if m is not r and m.trace is not None
+                      and not m.trace.sampled]
+            spans = [
+                make_span(serve_ctx, "serve", wall(r.t_submit), wall(None),
+                          model=entry.name, version=entry.version,
+                          mode=mode, rows=r.n),
+                make_span(serve_ctx.child(), "queue", wall(r.t_submit),
+                          wall(r.t_coalesce)),
+                make_span(d_ctx, "dispatch", wall(t_dispatch),
+                          wall(t_settle), links=links or None,
+                          group_requests=len(reqs),
+                          group_rows=group_rows,
+                          unsampled_mates=anon_mates or None,
+                          **(info or {})),
+                make_span(serve_ctx.child(), "respond", wall(t_settle),
+                          wall(None)),
+            ]
+            out[id(r)] = spans[:MAX_SPANS_PER_REQUEST]
+        return out
 
     @staticmethod
     def _record_trace(r: ServeRequest, entry, mode: str,

@@ -179,11 +179,16 @@ class ServingDaemon:
         return None  # finish_preemption() flushes and re-delivers
 
     # -------------------------------------------------------------- serving
-    def submit(self, model: str, X, mode: str = "predict") -> ServeFuture:
+    def submit(self, model: str, X, mode: str = "predict",
+               trace=None) -> ServeFuture:
         """Queue one request; returns its future.  Rejects (without
         queueing) unknown models, bad dtypes/shapes and feature-count
         mismatches — a malformed request must fail ITS caller, never
-        poison a coalesced bucket or force a fresh trace."""
+        poison a coalesced bucket or force a fresh trace.  `trace` is a
+        propagated TraceContext (docs/Observability.md "Distributed
+        tracing"): its id correlates this request across processes, and
+        a SAMPLED context makes the dispatcher attach the replica-side
+        child spans to the future (`future.spans`)."""
         if mode not in _MODES:
             raise ValueError(f"mode must be one of {_MODES} (got {mode!r})")
         from ..reliability import faults
@@ -204,7 +209,7 @@ class ServingDaemon:
                     f"features, request has {rows.shape[1]} (a varying "
                     "width would re-trace the bucket program)")
             req = ServeRequest(entry, rows, mode,
-                               early_stop=self._early_stop)
+                               early_stop=self._early_stop, trace=trace)
             self.coalescer.submit(req)
             return req.future
         except BaseException:
@@ -212,9 +217,10 @@ class ServingDaemon:
             raise
 
     def predict(self, model: str, X, mode: str = "predict",
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None, trace=None):
         """Blocking convenience wrapper over submit()."""
-        return self.submit(model, X, mode=mode).result(timeout=timeout)
+        return self.submit(model, X, mode=mode,
+                           trace=trace).result(timeout=timeout)
 
     # --------------------------------------------------------------- health
     # a shed inside this window marks the replica `shedding` on the
@@ -304,18 +310,31 @@ class ServingClient:
     caller on the next call (ISSUE 13 satellite).  `deadline_ms` rides
     each request: in-process it bounds the future wait; over TCP it
     propagates to the replica so the server gives up when the client
-    has."""
+    has.
+
+    Tracing (docs/Observability.md "Distributed tracing"): the client
+    is the outermost EDGE, so every request is stamped with a fresh
+    TraceContext (ids make failures greppable end to end); every
+    `trace_sample`-th request is stamped SAMPLED, which makes each hop
+    attach real spans.  `last_trace_id`/`last_spans` expose the most
+    recent request's identity and (sampled only) replica-side spans."""
 
     def __init__(self, daemon: Optional[ServingDaemon] = None,
                  address: Optional[Tuple[str, int]] = None,
                  request_timeout_s: float = 60.0,
-                 retry_backoff_ms: float = 25.0):
+                 retry_backoff_ms: float = 25.0,
+                 trace_sample: int = 0):
         if (daemon is None) == (address is None):
             raise ValueError("ServingClient needs exactly one of daemon= "
                              "(in-process) or address= (TCP)")
         self._daemon = daemon
         self._conn = None
         self._timeout_s = float(request_timeout_s)
+        self._trace_sample = max(int(trace_sample), 0)
+        self._trace_lock = threading.Lock()
+        self._trace_seq = 0
+        self.last_trace_id: Optional[str] = None
+        self.last_spans = None
         if address is not None:
             from .frontend import LineClient
             self._conn = LineClient(address[0], int(address[1]),
@@ -325,11 +344,24 @@ class ServingClient:
     @classmethod
     def connect(cls, host: str, port: int,
                 request_timeout_s: float = 60.0,
-                retry_backoff_ms: float = 25.0) -> "ServingClient":
+                retry_backoff_ms: float = 25.0,
+                trace_sample: int = 0) -> "ServingClient":
         """TCP client for a daemon's front end (`serve_port`)."""
         return cls(address=(host, port),
                    request_timeout_s=request_timeout_s,
-                   retry_backoff_ms=retry_backoff_ms)
+                   retry_backoff_ms=retry_backoff_ms,
+                   trace_sample=trace_sample)
+
+    def _edge_context(self, trace_ctx=None):
+        """Stamp (or pass through) the request's trace context."""
+        from ..observability.tracing import TraceContext
+        if trace_ctx is not None:
+            return trace_ctx
+        with self._trace_lock:
+            self._trace_seq += 1
+            sampled = (self._trace_sample > 0
+                       and self._trace_seq % self._trace_sample == 0)
+        return TraceContext.new(sampled=sampled)
 
     # ---------------------------------------------------------------- wire
     def _request(self, msg: dict,
@@ -348,29 +380,45 @@ class ServingClient:
         from .coalescer import ShedError
         err = reply.get("error", "serving error")
         if reply.get("shed"):
-            raise ShedError(err, pending=int(reply.get("pending", 0)))
-        if reply.get("timeout"):
-            raise TimeoutError(err)
-        raise RuntimeError(err)
+            exc: BaseException = ShedError(
+                err, pending=int(reply.get("pending", 0)))
+        elif reply.get("timeout"):
+            exc = TimeoutError(err)
+        else:
+            exc = RuntimeError(err)
+        # the server echoes the request's trace id on error replies so a
+        # client-side failure is greppable in replica logs / the flight
+        # recorder; surface it on the raised exception too
+        exc.trace_id = reply.get("trace_id")  # type: ignore[attr-defined]
+        raise exc
 
     # ----------------------------------------------------------------- API
     def predict(self, model: str, X, mode: str = "predict",
                 timeout: Optional[float] = None,
-                deadline_ms: Optional[float] = None):
+                deadline_ms: Optional[float] = None,
+                trace_ctx=None):
+        ctx = self._edge_context(trace_ctx)
         if self._daemon is not None:
             if deadline_ms is not None:
                 t = float(deadline_ms) / 1000.0
                 timeout = t if timeout is None else min(timeout, t)
-            return self._daemon.predict(model, X, mode=mode,
-                                        timeout=timeout)
+            fut = self._daemon.submit(model, X, mode=mode, trace=ctx)
+            out = fut.result(timeout=timeout)
+            with self._trace_lock:
+                self.last_trace_id = ctx.trace_id
+                self.last_spans = fut.spans
+            return out
         msg = {"model": model, "rows": np.asarray(X).tolist(),
-               "mode": mode}
+               "mode": mode, "trace": ctx.to_wire()}
         if deadline_ms is not None:
             msg["deadline_ms"] = float(deadline_ms)
         wait = timeout if timeout is not None else (
             float(deadline_ms) / 1000.0 + 1.0
             if deadline_ms is not None else None)
         reply = self._request(msg, timeout_s=wait)
+        with self._trace_lock:
+            self.last_trace_id = reply.get("trace_id", ctx.trace_id)
+            self.last_spans = reply.get("spans")
         return np.asarray(reply["preds"])
 
     def predict_async(self, model: str, X,

@@ -49,6 +49,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..observability import emit_event
+from ..observability.prom import parse_prometheus_text
 from ..observability.registry import global_registry
 from ..reliability.guard import classify_returncode
 from ..reliability.supervisor import tail_file
@@ -117,6 +118,131 @@ class ReplicaEndpoint:
         self.versions = versions
 
 
+class FleetAggregator:
+    """Merged fleet view of every replica's `/metrics` scrape
+    (docs/Observability.md "Fleet metrics & SLO").
+
+    The supervisor's health probe pulls each routable replica's
+    Prometheus page (`op=metrics` on the same wire round trip as
+    `op=health`) and records the parsed snapshot here; `render()`
+    produces ONE text block for the router's own `/metrics` page:
+
+    * `lgbm_fleet_<name>` counters — the per-series SUM over every
+      replica with a live scrape (so one router scrape answers "how
+      many requests did the FLEET serve" without K per-replica pulls);
+    * `lgbm_fleet_replica_{up,routable,restarts}{replica="i"}` gauges
+      from the supervisor's own state (a down replica has no scrape to
+      speak for it);
+    * merged latency quantiles: `lgbm_fleet_latency_ms{quantile=}` —
+      p50 as the serve-request-weighted mean of the replica p50s, p99
+      as the MAX over replicas (quantiles do not sum; the weighted
+      mean is the honest central estimate and the max is the
+      conservative tail bound — documented approximation).
+
+    A replica's snapshot is dropped when it goes down or restarts
+    (`forget`): a relaunched daemon restarts its counters from zero,
+    and a stale pre-crash snapshot would double-count its history."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # replica idx -> {"ts", "counters", "gauges"}
+        self._scrapes: Dict[int, Dict[str, object]] = {}
+
+    # ------------------------------------------------------------- writers
+    def record_scrape(self, idx: int, page: str) -> None:
+        parsed = parse_prometheus_text(page)
+        with self._lock:
+            self._scrapes[int(idx)] = {"ts": time.time(),
+                                       "counters": parsed["counters"],
+                                       "gauges": parsed["gauges"]}
+
+    def forget(self, idx: int) -> None:
+        """Drop a replica's snapshot (down or relaunched: its counter
+        history must not double-count into the merged view)."""
+        with self._lock:
+            self._scrapes.pop(int(idx), None)
+
+    # ------------------------------------------------------------- readers
+    def snapshot(self) -> Dict[int, Dict[str, object]]:
+        """Per-replica parsed scrapes (copies)."""
+        with self._lock:
+            return {i: {"ts": s["ts"],
+                        "counters": dict(s["counters"]),
+                        "gauges": dict(s["gauges"])}
+                    for i, s in self._scrapes.items()}
+
+    def merged_counters(self) -> Dict[str, float]:
+        """Per-series sums over every live replica scrape."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            scrapes = list(self._scrapes.values())
+        for s in scrapes:
+            for name, val in s["counters"].items():
+                out[name] = out.get(name, 0.0) + val
+        return out
+
+    def replica_counter(self, idx: int, series: str) -> float:
+        with self._lock:
+            s = self._scrapes.get(int(idx))
+            return float(s["counters"].get(series, 0.0)) if s else 0.0
+
+    def merged_latency_ms(self) -> Dict[str, Optional[float]]:
+        """{"p50": weighted mean, "p99": max} over replica quantile
+        gauges (see class docstring for the approximation)."""
+        with self._lock:
+            scrapes = list(self._scrapes.values())
+        p50s, p99s = [], []
+        for s in scrapes:
+            g = s["gauges"]
+            p50 = g.get('lgbm_serve_latency_ms{quantile="0.5"}')
+            p99 = g.get('lgbm_serve_latency_ms{quantile="0.99"}')
+            weight = s["counters"].get("lgbm_serve_requests", 0.0)
+            if p50 is not None and p50 == p50:       # NaN-safe
+                p50s.append((p50, max(weight, 1.0)))
+            if p99 is not None and p99 == p99:
+                p99s.append(p99)
+        p50 = (sum(v * w for v, w in p50s) / sum(w for _, w in p50s)
+               if p50s else None)
+        return {"p50": p50, "p99": max(p99s) if p99s else None}
+
+    # -------------------------------------------------------------- render
+    def render(self, describe: List[Dict[str, object]]) -> str:
+        """The router /metrics `text_cb` block (Prometheus text)."""
+        lines: List[str] = []
+        merged = self.merged_counters()
+        families: Dict[str, List[str]] = {}
+        for name in sorted(merged):
+            rest = name[len("lgbm_"):] if name.startswith("lgbm_") else name
+            base = "lgbm_fleet_" + rest.split("{", 1)[0]
+            series = ("lgbm_fleet_" + rest).split("{", 1)
+            rendered = series[0] + ("{" + series[1] if len(series) > 1
+                                    else "")
+            val = merged[name]
+            sval = str(int(val)) if val == int(val) else repr(val)
+            families.setdefault(base, []).append(f"{rendered} {sval}")
+        for base in sorted(families):
+            lines.append(f"# TYPE {base} counter")
+            lines.extend(families[base])
+        for field, kind in (("up", "healthy"), ("routable", "ready"),
+                            ("restarts", "restarts")):
+            lines.append(f"# TYPE lgbm_fleet_replica_{field} gauge")
+            for r in describe:
+                if field == "restarts":
+                    val = int(r.get("restarts", 0))
+                else:
+                    val = int(bool(r.get(kind)) and not r.get("down"))
+                lines.append(
+                    f'lgbm_fleet_replica_{field}{{replica="{r["idx"]}"}} '
+                    f"{val}")
+        lat = self.merged_latency_ms()
+        lines.append("# TYPE lgbm_fleet_latency_ms gauge")
+        for q, key in (("0.5", "p50"), ("0.99", "p99")):
+            v = lat[key]
+            lines.append(f'lgbm_fleet_latency_ms{{quantile="{q}"}} '
+                         + ("NaN" if v is None else f"{float(v):g}"))
+        return "\n".join(lines)
+
+
 class ReplicaFleet:
     """Spawn/adopt + supervise K serving replicas (docs/Serving.md).
 
@@ -150,6 +276,10 @@ class ReplicaFleet:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # merged fleet /metrics view, refreshed on the health-probe tick
+        # (docs/Observability.md "Fleet metrics & SLO"); always on — one
+        # op=metrics round trip per probe is noise next to the probe
+        self.aggregator = FleetAggregator()
         self.replicas: List[ReplicaState] = [
             ReplicaState(i) for i in range(int(num_replicas))]
         for host, port in adopt_endpoints:
@@ -354,6 +484,9 @@ class ReplicaFleet:
         kind = classify_returncode(rc)
         tail = tail_file(self._log_file(r.idx), max_bytes=2048)
         global_registry.inc("serve_replica_down")
+        # the dead process's counters are gone; a relaunch restarts them
+        # from zero — keeping the stale scrape would double-count
+        self.aggregator.forget(r.idx)
         with self._lock:
             r.healthy = False
             r.ready = False
@@ -385,7 +518,9 @@ class ReplicaFleet:
             return None  # not landed yet (atomic write: never torn)
 
     def _probe(self, r: ReplicaState, port: int) -> None:
-        """One `op=health` round trip; mutates r under the lock."""
+        """One `op=health` round trip (+ an `op=metrics` scrape for the
+        fleet aggregator on the same connection); mutates r under the
+        lock."""
         from .frontend import LineClient
         client = LineClient(r.host, port, connect_timeout_s=0.75,
                             max_connect_attempts=1)
@@ -397,12 +532,39 @@ class ReplicaFleet:
                 r.shedding = bool(h.get("shedding"))
                 r.versions = {str(k): int(v) for k, v in
                               (h.get("models") or {}).items()}
+            if h.get("ok"):
+                # the aggregator's scrape rides the probe tick: same
+                # wire, same connection, one extra round trip
+                m = client.request({"op": "metrics"}, timeout_s=2.0)
+                if m.get("ok") and m.get("metrics"):
+                    self.aggregator.record_scrape(r.idx, m["metrics"])
         except (ConnectionError, OSError):
             with self._lock:
                 r.healthy = False
                 r.ready = False
         finally:
             client.close()
+
+    def scrape_all(self) -> int:
+        """Force one synchronous aggregator refresh of every ROUTABLE
+        replica (tests and the bench compare merged-vs-per-replica
+        counters and need a consistent snapshot, not a probe-tick-stale
+        one).  Returns the number of replicas scraped."""
+        from .frontend import LineClient
+        n = 0
+        for ep in self.endpoints():
+            client = LineClient(ep.host, ep.port, connect_timeout_s=0.75,
+                                max_connect_attempts=1)
+            try:
+                m = client.request({"op": "metrics"}, timeout_s=5.0)
+                if m.get("ok") and m.get("metrics"):
+                    self.aggregator.record_scrape(ep.idx, m["metrics"])
+                    n += 1
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                client.close()
+        return n
 
     # -------------------------------------------------------------- access
     def endpoints(self, model: Optional[str] = None

@@ -49,6 +49,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability.tracing import TraceContext
 from ..utils import log
 from .coalescer import ShedError
 
@@ -64,9 +65,12 @@ class _Handler(socketserver.StreamRequestHandler):
             line = line.strip()
             if not line:
                 continue
+            trace_id = None   # echoed on EVERY reply shape, errors included
             try:
                 msg = json.loads(line)
                 op = msg.get("op", "predict")
+                ctx = TraceContext.from_wire(msg.get("trace"))
+                trace_id = ctx.trace_id if ctx is not None else None
                 if op == "stats":
                     self._reply({"ok": True, "stats": daemon.stats()})
                     continue
@@ -107,32 +111,50 @@ class _Handler(socketserver.StreamRequestHandler):
                 timeout_s = self.server.request_timeout_s
                 deadline_ms = msg.get("deadline_ms")
                 if deadline_ms is not None:
-                    if float(deadline_ms) <= 0:
+                    # fail fast below 1 ms remaining: even a warm
+                    # coalesced dispatch cannot answer inside that, and
+                    # the router's per-hop decrement clamps forwarded
+                    # deadlines to >= 1 ms — so sub-millisecond budgets
+                    # only arrive from clients that have already given
+                    # up (deterministic, instead of racing the
+                    # dispatcher for a microsecond future wait)
+                    if float(deadline_ms) < 1.0:
                         raise TimeoutError(
                             "deadline_ms exhausted before dispatch")
                     timeout_s = min(timeout_s, float(deadline_ms) / 1000.0)
                 fut = daemon.submit(msg.get("model", "default"), rows,
-                                    mode=msg.get("mode", "predict"))
+                                    mode=msg.get("mode", "predict"),
+                                    trace=ctx)
                 out = fut.result(timeout=timeout_s)
-                self._reply({"ok": True, "version": fut.version,
-                             "latency_ms": round(fut.latency_ms, 3),
-                             "preds": np.asarray(out).tolist()})
+                reply = {"ok": True, "version": fut.version,
+                         "latency_ms": round(fut.latency_ms, 3),
+                         "preds": np.asarray(out).tolist()}
+                if trace_id is not None:
+                    reply["trace_id"] = trace_id
+                    # sampled context: the replica-side child spans ride
+                    # the envelope back to the router's SpanAssembler
+                    spans = fut.spans
+                    if spans:
+                        reply["spans"] = spans
+                self._reply(reply)
             except ShedError as e:
                 # structured shed: retryable elsewhere, by contract
                 try:
                     self._reply({"ok": False, "shed": True,
-                                 "error": str(e), "pending": e.pending})
+                                 "error": str(e), "pending": e.pending,
+                                 "trace_id": trace_id})
                 except OSError:
                     return
             except TimeoutError as e:
                 try:
                     self._reply({"ok": False, "timeout": True,
-                                 "error": str(e)})
+                                 "error": str(e), "trace_id": trace_id})
                 except OSError:
                     return
             except Exception as e:  # noqa: BLE001 - per-line error reply
                 try:
-                    self._reply({"ok": False, "error": str(e)})
+                    self._reply({"ok": False, "error": str(e),
+                                 "trace_id": trace_id})
                 except OSError:
                     return  # peer went away mid-reply
 

@@ -56,8 +56,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observability import emit_event
-from ..observability.flightrec import flight_recorder
 from ..observability.registry import LatencyWindow, global_registry
+from ..observability.tracing import (MAX_SPANS_PER_REQUEST, SloTracker,
+                                     SpanAssembler, TraceContext,
+                                     make_span)
 from ..utils import log
 from .coalescer import ShedError
 from .fleet import ReplicaEndpoint, ReplicaFleet
@@ -77,16 +79,22 @@ class NoReplicaError(RuntimeError):
 
 class RouterReply:
     """One routed request's outcome: result rows plus which replica and
-    model version served it and how many retries it took."""
+    model version served it, how many retries it took, and the
+    request's trace id (greppable in replica logs and the flight
+    recorder; `op=trace` / `GET /trace/<id>` resolves it to the
+    assembled waterfall when the request was sampled)."""
 
-    __slots__ = ("preds", "version", "replica", "retries", "latency_ms")
+    __slots__ = ("preds", "version", "replica", "retries", "latency_ms",
+                 "trace_id")
 
-    def __init__(self, preds, version, replica, retries, latency_ms):
+    def __init__(self, preds, version, replica, retries, latency_ms,
+                 trace_id=None):
         self.preds = preds
         self.version = version
         self.replica = replica
         self.retries = retries
         self.latency_ms = latency_ms
+        self.trace_id = trace_id
 
 
 class _Welford:
@@ -154,6 +162,19 @@ class Router:
         self._tls = threading.local()        # per-thread replica conns
         self.frontend = None
         self.metrics_server = None
+        # cross-process span assembly (docs/Observability.md
+        # "Distributed tracing"): sampled requests' spans from every hop
+        # join here into the op=trace / GET /trace/<id> waterfalls
+        self.assembler = SpanAssembler()
+        # SLO burn-rate tracking over the router's own request-outcome
+        # stream (client-perceived, so retries/sheds are already folded
+        # in); inert until serve_slo_p99_ms > 0
+        self.slo = SloTracker(
+            p99_ms=float(config.serve_slo_p99_ms),
+            error_pct=float(config.serve_slo_error_pct),
+            fast_window_s=float(config.serve_slo_fast_window_s),
+            slow_window_s=float(config.serve_slo_slow_window_s),
+            burn_threshold=float(config.serve_slo_burn_threshold))
 
     # ---------------------------------------------------------- connections
     def _conn_for(self, ep: ReplicaEndpoint) -> LineClient:
@@ -205,13 +226,86 @@ class Router:
                   or fresh)
         return ranked[cursor % len(ranked)]
 
+    def _edge_context(self, trace) -> TraceContext:
+        """The trace context for one routed request: honor an incoming
+        wire context (the client edge already stamped one), else
+        generate here — the router IS the edge for bare clients.  Every
+        request gets ids (error replies and replica logs carry the
+        trace_id either way); the `sampled` flag — every
+        `serve_trace_sample`-th edge-generated request — decides
+        whether spans are collected and assembled."""
+        ctx = TraceContext.from_wire(trace) if trace is not None else None
+        if ctx is not None:
+            return ctx
+        with self._lock:
+            self._seq += 1
+            sampled = (self._trace_sample > 0
+                       and self._seq % self._trace_sample == 0)
+        return TraceContext.new(sampled=sampled)
+
     def predict(self, model: str, rows, mode: str = "predict",
-                deadline_ms: Optional[float] = None) -> RouterReply:
+                deadline_ms: Optional[float] = None,
+                trace=None) -> RouterReply:
         """Route one predict with retry/backoff + deadline propagation.
         Raises OverloadedError (every attempt shed / fleet saturated),
         NoReplicaError, TimeoutError (deadline exhausted), or the
-        replica's non-retryable error (bad rows, unknown model)."""
+        replica's non-retryable error (bad rows, unknown model); every
+        raised error carries `.trace_id` so a client-side failure is
+        greppable in replica logs and the flight recorder.  `trace` is
+        the wire-format context dict (honored when present, generated
+        at this edge when absent); sampled requests assemble a
+        cross-process span waterfall into `self.assembler`."""
         t0 = time.monotonic()
+        w0 = time.time()
+
+        def wall(mono: float) -> float:
+            return w0 + (mono - t0)
+
+        ctx = self._edge_context(trace)
+        route_ctx = ctx.child()
+        spans: List[Dict] = []       # router-side spans (sampled only)
+        replica_spans: List[Dict] = []
+
+        def finish_ok(reply, ep, retries) -> RouterReply:
+            lat = (time.monotonic() - t0) * 1000.0
+            self.latency.record(lat)
+            global_registry.inc("router_requests")
+            global_registry.inc("router_rows", n_rows)
+            preds = np.asarray(reply["preds"])
+            self._observe(model, ep, preds=preds)
+            self.slo.observe(lat, ok=True)
+            if ctx.sampled:
+                replica_spans.extend(reply.get("spans") or ())
+                spans.append(make_span(
+                    route_ctx, "route", w0, wall(time.monotonic()),
+                    model=model, rows=n_rows, retries=retries,
+                    replica=ep.idx, deadline_ms=deadline_ms))
+                self.assembler.assemble(
+                    ctx.trace_id,
+                    (spans + replica_spans)[:MAX_SPANS_PER_REQUEST],
+                    model=model, rows=n_rows, retries=retries,
+                    latency_ms=round(lat, 3), outcome="ok")
+            return RouterReply(preds, reply.get("version"), ep.idx,
+                               retries, lat, trace_id=ctx.trace_id)
+
+        def fail(exc: BaseException) -> BaseException:
+            """Terminal failure: stamp the trace id on the exception,
+            feed the SLO tracker, and (sampled) assemble the partial
+            waterfall so the failure is findable by id."""
+            exc.trace_id = ctx.trace_id  # type: ignore[attr-defined]
+            self.slo.observe((time.monotonic() - t0) * 1000.0, ok=False)
+            if ctx.sampled:
+                spans.append(make_span(
+                    route_ctx, "route", w0, wall(time.monotonic()),
+                    model=model, rows=n_rows, retries=retries,
+                    outcome="error", error=str(exc)[:200]))
+                self.assembler.assemble(
+                    ctx.trace_id,
+                    (spans + replica_spans)[:MAX_SPANS_PER_REQUEST],
+                    model=model, outcome="error",
+                    error=str(exc)[:200])
+            return exc
+
         budget_s = (float(deadline_ms) / 1000.0
                     if deadline_ms is not None else self.timeout_s)
         deadline = t0 + budget_s
@@ -229,24 +323,24 @@ class Router:
         eps = self.fleet.endpoints(model)
         if eps and all(ep.shedding for ep in eps):
             global_registry.inc("serve_overloaded")
-            raise OverloadedError(
+            raise fail(OverloadedError(
                 f"fleet overloaded: all {len(eps)} routable replicas "
-                "are shedding")
+                "are shedding"))
         for attempt in range(self.retry_max + 1):
             remaining = deadline - time.monotonic()
             if remaining <= 0.001:
                 global_registry.inc("router_failed")
-                raise TimeoutError(
+                raise fail(TimeoutError(
                     f"deadline_ms={deadline_ms} exhausted after "
                     f"{attempt} attempt(s)"
-                    + (f" (last: {last_error})" if last_error else ""))
+                    + (f" (last: {last_error})" if last_error else "")))
             ep = self._pick(model, tried)
             if ep is None:
                 if not tried:
                     global_registry.inc("router_failed")
-                    raise NoReplicaError(
+                    raise fail(NoReplicaError(
                         f"no routable replica for model {model!r} "
-                        f"(fleet: {self.fleet.describe()})")
+                        f"(fleet: {self.fleet.describe()})"))
                 # every routable replica tried once; with retry budget
                 # (and deadline) remaining, start a fresh round — a
                 # shed or a mid-restart replica may well answer the
@@ -257,6 +351,7 @@ class Router:
                 if ep is None:
                     break
             tried.add(ep.idx)
+            backoff = 0.0
             if attempt > 0:
                 retries += 1
                 global_registry.inc("router_retries")
@@ -267,8 +362,23 @@ class Router:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0.001:
                     continue  # the deadline check above raises
+            # each attempt is its own child span context; the replica
+            # parents its `serve` span under it, so the assembled
+            # waterfall shows exactly which attempt did the work
+            attempt_ctx = route_ctx.child()
+            a_start = time.monotonic()
+
+            def note_attempt(outcome: str) -> None:
+                if ctx.sampled and len(spans) < MAX_SPANS_PER_REQUEST:
+                    spans.append(make_span(
+                        attempt_ctx, "attempt", wall(a_start),
+                        wall(time.monotonic()), replica=ep.idx,
+                        gen=ep.gen, outcome=outcome,
+                        backoff_ms=round(backoff * 1000.0, 3) or None))
+
             msg = {"model": model, "rows": rows_list, "mode": mode,
-                   "deadline_ms": max(remaining * 1000.0, 1.0)}
+                   "deadline_ms": max(remaining * 1000.0, 1.0),
+                   "trace": attempt_ctx.to_wire()}
             attempts_made += 1
             try:
                 reply = self._conn_for(ep).request(
@@ -279,56 +389,37 @@ class Router:
                 last_error = e
                 global_registry.inc("router_conn_errors")
                 self._observe(model, ep, error=True)
+                note_attempt("conn_error")
                 continue
             if reply.get("ok"):
-                lat = (time.monotonic() - t0) * 1000.0
-                self.latency.record(lat)
-                global_registry.inc("router_requests")
-                global_registry.inc("router_rows", n_rows)
-                preds = np.asarray(reply["preds"])
-                self._observe(model, ep, preds=preds)
-                self._trace(model, ep, n_rows, retries, lat, deadline_ms)
-                return RouterReply(preds, reply.get("version"), ep.idx,
-                                   retries, lat)
+                note_attempt("ok")
+                return finish_ok(reply, ep, retries)
             if reply.get("shed"):
                 sheds += 1
                 last_error = ShedError(reply.get("error", "shed"))
                 global_registry.inc("serve_shed")
+                note_attempt("shed")
                 continue
             if reply.get("timeout"):
                 last_error = TimeoutError(reply.get("error", "timeout"))
                 global_registry.inc("router_timeouts")
                 self._observe(model, ep, error=True)
+                note_attempt("timeout")
                 continue
             # non-retryable: the request itself is wrong (unknown
             # model, bad rows, width mismatch) — retrying cannot fix it
             global_registry.inc("router_failed")
             self._observe(model, ep, error=True)
-            raise RuntimeError(reply.get("error", "serving error"))
+            note_attempt("error")
+            raise fail(RuntimeError(reply.get("error", "serving error")))
         global_registry.inc("router_failed")
         if sheds and sheds == attempts_made:
             global_registry.inc("serve_overloaded")
-            raise OverloadedError(
-                f"fleet overloaded: all {sheds} attempts shed")
-        raise RuntimeError(
+            raise fail(OverloadedError(
+                f"fleet overloaded: all {sheds} attempts shed"))
+        raise fail(RuntimeError(
             f"request failed after {attempts_made} attempt(s) "
-            f"({retries} retries): {last_error}")
-
-    def _trace(self, model: str, ep: ReplicaEndpoint, n_rows: int,
-               retries: int, latency_ms: float,
-               deadline_ms: Optional[float]) -> None:
-        if not self._trace_sample:
-            return
-        with self._lock:
-            self._seq += 1
-            take = self._seq % self._trace_sample == 0
-        if take:
-            flight_recorder.record_trace(
-                trace_id=flight_recorder.next_trace_id(),
-                kind="router", model=model, replica=ep.idx,
-                rows=n_rows, retries=retries,
-                latency_ms=round(latency_ms, 3),
-                deadline_ms=deadline_ms)
+            f"({retries} retries): {last_error}"))
 
     # -------------------------------------------------------------- rollout
     def register_incumbent(self, model: str, path: str) -> None:
@@ -554,6 +645,13 @@ class Router:
             "router_p99_ms": p99,
             "replicas": self.fleet.describe(),
             "canaries": canaries,
+            "traces_assembled": len(self.assembler.ids()),
+            "fleet_metrics": {
+                "replicas_scraped":
+                    len(self.fleet.aggregator.snapshot()),
+                "latency_ms": self.fleet.aggregator.merged_latency_ms(),
+            },
+            **({"slo": self.slo.stats()} if self.slo.enabled else {}),
         }
 
     def health(self) -> Dict[str, object]:
@@ -567,13 +665,29 @@ class Router:
         """Live gauges for the /metrics page (prom.py gauges_cb)."""
         p50, p99 = self.latency.percentiles((50.0, 99.0))
         desc = self.fleet.describe()
-        return {
+        out = {
             "router_p50_ms": p50 if p50 is not None else float("nan"),
             "router_p99_ms": p99 if p99 is not None else float("nan"),
             "fleet_replicas_routable": float(len(self.fleet.endpoints())),
             "fleet_replicas_down": float(
                 sum(1 for r in desc if r["down"])),
         }
+        if self.slo.enabled:
+            rates = self.slo.burn_rates()
+            out["slo_burn_rate_fast"] = rates["fast"]
+            out["slo_burn_rate_slow"] = rates["slow"]
+        return out
+
+    def _fleet_metrics_block(self) -> str:
+        """The /metrics `text_cb`: merged per-replica scrape families
+        (fleet.FleetAggregator.render)."""
+        return self.fleet.aggregator.render(self.fleet.describe())
+
+    def trace_lookup(self, trace_id: Optional[str] = None):
+        """`GET /trace/<id>` / `op=trace` resolver: the assembled
+        waterfall for `trace_id`, or the newest when None."""
+        return (self.assembler.get(trace_id) if trace_id
+                else self.assembler.latest())
 
     # ------------------------------------------------------------ front end
     def start_frontend(self, port: int = 0, host: str = "127.0.0.1",
@@ -582,7 +696,9 @@ class Router:
         if metrics_port >= 0 and self.metrics_server is None:
             from ..observability import start_metrics_http
             self.metrics_server = start_metrics_http(
-                port=metrics_port, gauges_cb=self._metric_gauges)
+                port=metrics_port, gauges_cb=self._metric_gauges,
+                text_cb=self._fleet_metrics_block,
+                traces_cb=self.trace_lookup)
         return self.frontend
 
     def stop(self) -> None:
@@ -629,7 +745,23 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 if op == "metrics":
                     from ..observability import render_prometheus
                     self._reply({"ok": True, "metrics": render_prometheus(
-                        gauges_cb=router._metric_gauges)})
+                        gauges_cb=router._metric_gauges,
+                        text_cb=router._fleet_metrics_block)})
+                    continue
+                if op == "trace":
+                    # debug surface: the assembled cross-process
+                    # waterfall by id (or the newest sampled one)
+                    tid = msg.get("trace_id") or msg.get("id")
+                    trace = router.trace_lookup(tid)
+                    if trace is None:
+                        self._reply({"ok": False,
+                                     "error": "no such trace (sampled "
+                                              "out, evicted, or never "
+                                              "assembled)",
+                                     "retained": router.assembler
+                                     .ids()[-8:]})
+                    else:
+                        self._reply({"ok": True, "trace": trace})
                     continue
                 if op == "publish":
                     out = router.publish(
@@ -642,32 +774,38 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 r = router.predict(
                     msg.get("model", "default"), msg["rows"],
                     mode=msg.get("mode", "predict"),
-                    deadline_ms=msg.get("deadline_ms"))
+                    deadline_ms=msg.get("deadline_ms"),
+                    trace=msg.get("trace"))
                 self._reply({"ok": True, "version": r.version,
                              "replica": r.replica, "retries": r.retries,
                              "latency_ms": round(r.latency_ms, 3),
+                             "trace_id": r.trace_id,
                              "preds": np.asarray(r.preds).tolist()})
             except OverloadedError as e:
                 try:
                     self._reply({"ok": False, "overloaded": True,
-                                 "error": str(e)})
+                                 "error": str(e),
+                                 "trace_id": getattr(e, "trace_id", None)})
                 except OSError:
                     return
             except ShedError as e:
                 try:
                     self._reply({"ok": False, "shed": True,
-                                 "error": str(e)})
+                                 "error": str(e),
+                                 "trace_id": getattr(e, "trace_id", None)})
                 except OSError:
                     return
             except TimeoutError as e:
                 try:
                     self._reply({"ok": False, "timeout": True,
-                                 "error": str(e)})
+                                 "error": str(e),
+                                 "trace_id": getattr(e, "trace_id", None)})
                 except OSError:
                     return
             except Exception as e:  # noqa: BLE001 - per-line error reply
                 try:
-                    self._reply({"ok": False, "error": str(e)})
+                    self._reply({"ok": False, "error": str(e),
+                                 "trace_id": getattr(e, "trace_id", None)})
                 except OSError:
                     return
 

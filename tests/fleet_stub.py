@@ -85,10 +85,20 @@ def main() -> int:
                                          {"requests": state["requests"]}})
                         continue
                     if op == "metrics":
-                        self._reply({"ok": True, "metrics":
-                                     "# TYPE stub counter\nstub 1\n"})
+                        with lock:
+                            n_req = state["requests"]
+                        self._reply({"ok": True, "metrics": (
+                            "# TYPE lgbm_serve_requests counter\n"
+                            f"lgbm_serve_requests {n_req}\n"
+                            "# TYPE lgbm_serve_latency_ms gauge\n"
+                            'lgbm_serve_latency_ms{quantile="0.5"} 0.1\n'
+                            'lgbm_serve_latency_ms{quantile="0.99"} 0.2\n')})
                         continue
-                    # predict
+                    # predict: echo the trace context like a real
+                    # replica — trace_id on every reply (errors too),
+                    # one "serve" span back when the context is sampled
+                    trace = msg.get("trace") or {}
+                    trace_id = trace.get("id")
                     with lock:
                         state["requests"] += 1
                         n = state["requests"]
@@ -98,13 +108,24 @@ def main() -> int:
                         os._exit(17)
                     if os.environ.get("STUB_SHED") == "1":
                         self._reply({"ok": False, "shed": True,
-                                     "error": "stub shed", "pending": 0})
+                                     "error": "stub shed", "pending": 0,
+                                     "trace_id": trace_id})
                         continue
                     if slow_ms:
                         time.sleep(slow_ms / 1000.0)
                     preds = [sum(r) * scale for r in msg["rows"]]
-                    self._reply({"ok": True, "version": version,
-                                 "latency_ms": 0.1, "preds": preds})
+                    reply = {"ok": True, "version": version,
+                             "latency_ms": 0.1, "preds": preds}
+                    if trace_id is not None:
+                        reply["trace_id"] = trace_id
+                        if trace.get("sampled"):
+                            reply["spans"] = [{
+                                "trace_id": trace_id,
+                                "span_id": os.urandom(4).hex(),
+                                "parent_id": trace.get("span"),
+                                "name": "serve", "ts": time.time(),
+                                "dur_ms": 0.1, "pid": os.getpid()}]
+                    self._reply(reply)
                 except Exception as e:  # noqa: BLE001 - per-line reply
                     try:
                         self._reply({"ok": False, "error": str(e)})

@@ -1,0 +1,368 @@
+"""Distributed tracing + fleet observability plane (ISSUE 14,
+docs/Observability.md "Distributed tracing" / "Fleet metrics & SLO").
+
+Stub replicas (tests/fleet_stub.py, no jax) exercise the cross-process
+half — context propagation through router retries, span envelopes,
+aggregator scrapes — in milliseconds; SloTracker/SpanAssembler/
+parse_prometheus_text are unit-tested with injected clocks and pages;
+the error-correlation contract (trace_id on every error reply) runs
+against a real frontend with no models loaded (no compiles needed).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.observability import set_event_logger
+from lightgbm_tpu.observability.events import EventLogger
+from lightgbm_tpu.observability.registry import (MetricsRegistry,
+                                                 global_registry)
+from lightgbm_tpu.observability.tracing import (SloTracker, SpanAssembler,
+                                                TraceContext, make_span)
+from lightgbm_tpu.observability.prom import (parse_prometheus_text,
+                                             render_prometheus)
+from lightgbm_tpu.serving import (FleetAggregator, ReplicaFleet, Router,
+                                  ServingDaemon, serve_counters_reset,
+                                  start_frontend)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(REPO, "tests", "fleet_stub.py")
+
+
+def _mk_fleet(workdir, n=2, envs=None, entries=(("m", "scale1"),)):
+    fault_envs = {}
+    for i in range(n):
+        e = {"STUB_READY_FILE": os.path.join(
+            str(workdir), f"replica-{i}.ready.json")}
+        e.update((envs or {}).get(i, {}))
+        fault_envs[i] = e
+    return ReplicaFleet(
+        n, list(entries), str(workdir), max_restarts=2,
+        health_interval_s=0.1,
+        spawn_cmd=lambda idx, rf: [sys.executable, STUB],
+        fault_envs=fault_envs)
+
+
+def _mk_router(fleet, **overrides):
+    p = {"serve_retry_max": 3, "serve_retry_backoff_ms": 5.0,
+         "serve_request_timeout_s": 15.0, "serve_trace_sample": 1}
+    p.update(overrides)
+    return Router(fleet, Config(p))
+
+
+ROWS = np.arange(12, dtype=np.float64).reshape(3, 4)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    serve_counters_reset()
+    for key in ("router_requests", "router_retries", "router_failed",
+                "slo_burn_total"):
+        global_registry.inc(key, -global_registry.counter(key))
+    yield
+    set_event_logger(None)
+
+
+# ------------------------------------------------------------ unit: context
+def test_trace_context_wire_round_trip_and_child():
+    ctx = TraceContext.new(sampled=True)
+    back = TraceContext.from_wire(ctx.to_wire())
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    # malformed wire fields parse to None, never raise
+    assert TraceContext.from_wire(None) is None
+    assert TraceContext.from_wire({"id": "x"}) is None
+    assert TraceContext.from_wire("garbage") is None
+    # ids are unique across contexts
+    ids = {TraceContext.new().trace_id for _ in range(64)}
+    assert len(ids) == 64
+
+
+def test_make_span_drops_none_attrs_and_clamps_duration():
+    ctx = TraceContext.new(sampled=True)
+    s = make_span(ctx, "x", 10.0, 9.0, replica=3, backoff_ms=None)
+    assert s["dur_ms"] == 0.0          # negative wall delta clamps
+    assert s["attrs"] == {"replica": 3}
+    assert s["trace_id"] == ctx.trace_id and s["pid"] == os.getpid()
+
+
+# -------------------------------------------------------- unit: assembler
+def test_assembler_waterfall_monotone_and_bounded():
+    asm = SpanAssembler(capacity=8)
+    ctx = TraceContext.new(sampled=True)
+    # deliberately out of order: the assembler must sort and offset
+    spans = [make_span(ctx.child(), "late", 105.0, 106.0),
+             make_span(ctx.child(), "early", 100.0, 101.0),
+             make_span(ctx.child(), "mid", 102.5, 103.0)]
+    tr = asm.assemble(ctx.trace_id, spans, outcome="ok")
+    rels = [s["rel_ms"] for s in tr["spans"]]
+    assert rels == sorted(rels) and rels[0] == 0.0
+    assert [s["name"] for s in tr["spans"]] == ["early", "mid", "late"]
+    assert asm.get(ctx.trace_id)["outcome"] == "ok"
+    assert asm.latest()["trace_id"] == ctx.trace_id
+    # bounded retention: old ids evict, newest survive
+    for _ in range(20):
+        c = TraceContext.new(sampled=True)
+        asm.assemble(c.trace_id, [make_span(c.child(), "s", 0.0, 1.0)])
+    assert len(asm.ids()) == 8
+    assert asm.get(ctx.trace_id) is None
+
+
+# ------------------------------------------------------------- unit: SLO
+def test_slo_tracker_multi_window_burn_and_event(tmp_path):
+    set_event_logger(EventLogger(str(tmp_path), rank=0))
+    global_registry.inc("slo_burn_total",
+                        -global_registry.counter("slo_burn_total"))
+    t = SloTracker(p99_ms=100.0, error_pct=1.0, fast_window_s=10.0,
+                   slow_window_s=100.0, burn_threshold=1.0)
+    # healthy traffic: fast latencies, no burn
+    for i in range(64):
+        t.observe(10.0, ok=True, now=float(i) * 0.1)
+    assert not t.evaluate(now=6.4)
+    assert global_registry.gauge("fleet_slo_burning") == 0.0
+    # an acute breach: slow + failed requests swamp the 1% budget in
+    # BOTH windows -> burning, exactly one slo_burn on the transition
+    for i in range(32):
+        t.observe(500.0, ok=(i % 2 == 0), now=7.0 + i * 0.01)
+    assert t.evaluate(now=7.5)
+    assert t.burning
+    assert global_registry.gauge("fleet_slo_burning") == 1.0
+    assert global_registry.counter("slo_burn_total") == 1
+    rates = t.burn_rates(now=7.5)
+    assert rates["fast"] > 1.0 and rates["slow"] > 1.0
+    # still burning: no second event
+    t.observe(500.0, ok=False, now=7.6)
+    t.evaluate(now=7.6)
+    assert global_registry.counter("slo_burn_total") == 1
+    # windows drain past the breach -> cleared
+    assert not t.evaluate(now=500.0)
+    assert global_registry.gauge("fleet_slo_burning") == 0.0
+    set_event_logger(None)
+    events = [json.loads(ln) for ln in
+              open(tmp_path / "events-rank0.jsonl")]
+    burns = [e for e in events if e["event"] == "slo_burn"]
+    assert len(burns) == 1
+    assert burns[0]["slo_p99_ms"] == 100.0
+    assert burns[0]["burn_rate_fast"] > 1.0
+
+
+def test_slo_tracker_disabled_is_inert():
+    t = SloTracker(p99_ms=0.0)
+    t.observe(1e9, ok=False)
+    assert not t.evaluate()
+    assert not t.enabled
+
+
+# ------------------------------------------- unit: prom parse + aggregator
+def test_parse_prometheus_round_trips_render():
+    reg = MetricsRegistry()
+    reg.inc("serve_requests", 41)
+    reg.inc("serve_requests_by_model::higgs", 17)
+    reg.set_gauge("queue_depth", 3)
+    page = render_prometheus(registry=reg)
+    parsed = parse_prometheus_text(page)
+    assert parsed["counters"]["lgbm_serve_requests"] == 41
+    assert parsed["counters"][
+        'lgbm_serve_requests_by_model{model="higgs"}'] == 17
+    assert parsed["gauges"]["lgbm_queue_depth"] == 3
+    # junk lines are skipped, not fatal
+    assert parse_prometheus_text("!! not a metric\nx y z\n") == \
+        {"counters": {}, "gauges": {}}
+
+
+def test_fleet_aggregator_merges_counters_exactly():
+    agg = FleetAggregator()
+    r0 = MetricsRegistry()
+    r0.inc("serve_requests", 30)
+    r0.inc("serve_rows", 120)
+    r0.inc("serve_requests_by_model::m", 30)
+    r1 = MetricsRegistry()
+    r1.inc("serve_requests", 12)
+    r1.inc("serve_requests_by_model::m", 12)
+    agg.record_scrape(0, render_prometheus(registry=r0))
+    agg.record_scrape(1, render_prometheus(registry=r1))
+    merged = agg.merged_counters()
+    assert merged["lgbm_serve_requests"] == 42
+    assert merged["lgbm_serve_rows"] == 120        # only replica 0 had it
+    assert merged['lgbm_serve_requests_by_model{model="m"}'] == 42
+    assert agg.replica_counter(1, "lgbm_serve_requests") == 12
+    # a forgotten (down/relaunched) replica stops counting
+    agg.forget(0)
+    assert agg.merged_counters()["lgbm_serve_requests"] == 12
+    # rendered block: merged families + per-replica supervisor gauges
+    desc = [{"idx": 0, "healthy": True, "ready": True, "down": False,
+             "restarts": 0},
+            {"idx": 1, "healthy": False, "ready": False, "down": True,
+             "restarts": 2}]
+    block = agg.render(desc)
+    assert "lgbm_fleet_serve_requests 12" in block
+    assert 'lgbm_fleet_replica_up{replica="0"} 0' in block \
+        or 'lgbm_fleet_replica_up{replica="0"} 1' in block
+    assert 'lgbm_fleet_replica_restarts{replica="1"} 2' in block
+    for ln in block.splitlines():
+        if ln and not ln.startswith("#"):
+            assert len(ln.rsplit(" ", 1)) == 2     # well-formed lines
+
+
+# --------------------------------------- stub fleet: propagation + retry
+def test_trace_survives_retry_onto_second_replica(tmp_path):
+    """The context stamped at the edge rides the retry: the assembled
+    trace shows TWO attempt child spans (first shed, second ok) under
+    one route span, plus the serving replica's serve span."""
+    fleet = _mk_fleet(tmp_path, n=2,
+                      envs={0: {"STUB_SHED": "1"}}).start()
+    router = _mk_router(fleet)
+    try:
+        assert fleet.wait_ready(timeout=20)
+        retried = None
+        for _ in range(8):
+            r = router.predict("m", ROWS)
+            assert r.trace_id
+            tr = router.assembler.get(r.trace_id)
+            assert tr is not None
+            if r.retries >= 1:
+                retried = tr
+                break
+        assert retried is not None, "no request hit the shedding replica"
+        names = [s["name"] for s in retried["spans"]]
+        attempts = [s for s in retried["spans"] if s["name"] == "attempt"]
+        assert len(attempts) == 2
+        outcomes = [a["attrs"]["outcome"] for a in attempts]
+        assert outcomes.count("shed") == 1 and outcomes.count("ok") == 1
+        # the two attempts hit DIFFERENT replicas
+        assert len({a["attrs"]["replica"] for a in attempts}) == 2
+        assert names.count("route") == 1
+        serves = [s for s in retried["spans"] if s["name"] == "serve"]
+        assert len(serves) == 1                      # one served span
+        # the replica's span came from ANOTHER process and parents under
+        # the attempt that succeeded
+        ok_attempt = next(a for a in attempts
+                          if a["attrs"]["outcome"] == "ok")
+        assert serves[0]["pid"] != os.getpid()
+        assert serves[0]["parent_id"] == ok_attempt["span_id"]
+        assert len(retried["processes"]) == 2
+        # waterfall is monotone
+        rels = [s["rel_ms"] for s in retried["spans"]]
+        assert rels == sorted(rels) and all(r >= 0 for r in rels)
+    finally:
+        router.stop()
+        fleet.stop(drain=False)
+
+
+def test_sampling_honors_serve_trace_sample(tmp_path):
+    fleet = _mk_fleet(tmp_path, n=1).start()
+    router = _mk_router(fleet, serve_trace_sample=4)
+    try:
+        assert fleet.wait_ready(timeout=20)
+        for _ in range(8):
+            r = router.predict("m", ROWS)
+            assert r.trace_id      # ids stamp EVERY request...
+        assert len(router.assembler.ids()) == 2   # ...spans every 4th
+        # sample=0 turns span assembly off entirely
+        router2 = _mk_router(fleet, serve_trace_sample=0)
+        for _ in range(4):
+            router2.predict("m", ROWS)
+        assert router2.assembler.ids() == []
+    finally:
+        router.stop()
+        fleet.stop(drain=False)
+
+
+def test_router_error_carries_trace_id(tmp_path):
+    fleet = _mk_fleet(tmp_path, n=1).start()
+    router = _mk_router(fleet)
+    try:
+        assert fleet.wait_ready(timeout=20)
+        with pytest.raises(RuntimeError) as ei:
+            # strings break the stub's sum() -> non-retryable error
+            router.predict("m", [["not", "numbers", "x", "y"]])
+        assert getattr(ei.value, "trace_id", None)
+        # the failure assembled a partial waterfall findable by id
+        tr = router.assembler.get(ei.value.trace_id)
+        assert tr is not None and tr["outcome"] == "error"
+    finally:
+        router.stop()
+        fleet.stop(drain=False)
+
+
+def test_aggregator_scrapes_stub_replicas_on_probe_tick(tmp_path):
+    fleet = _mk_fleet(tmp_path, n=2).start()
+    router = _mk_router(fleet)
+    try:
+        assert fleet.wait_ready(timeout=20)
+        n_req = 6
+        for _ in range(n_req):
+            router.predict("m", ROWS)
+        assert fleet.scrape_all() == 2
+        snap = fleet.aggregator.snapshot()
+        assert set(snap) == {0, 1}
+        per = {i: s["counters"]["lgbm_serve_requests"]
+               for i, s in snap.items()}
+        merged = fleet.aggregator.merged_counters()["lgbm_serve_requests"]
+        assert merged == sum(per.values()) == n_req
+        assert all(v > 0 for v in per.values())   # round robin hit both
+        # probe loop keeps the aggregator warm without scrape_all too
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(fleet.aggregator.snapshot()) == 2:
+                break
+            time.sleep(0.05)
+        assert len(fleet.aggregator.snapshot()) == 2
+        # the router's op=metrics page carries the merged family
+        from lightgbm_tpu.observability import render_prometheus as rp
+        page = rp(gauges_cb=router._metric_gauges,
+                  text_cb=router._fleet_metrics_block)
+        assert f"lgbm_fleet_serve_requests {n_req}" in page
+    finally:
+        router.stop()
+        fleet.stop(drain=False)
+
+
+# ------------------------------------ real frontend: error trace_id echo
+def test_frontend_error_reply_echoes_trace_id():
+    """A replica-side failure (unknown model here — no model load, no
+    compile) must answer with the request's trace_id so the client can
+    grep replica logs / the flight recorder for it (ISSUE 14
+    satellite)."""
+    import socket
+    d = ServingDaemon(Config({"verbosity": -1})).start()
+    srv = start_frontend(d, port=0)
+    try:
+        ctx = TraceContext.new(sampled=True)
+        with socket.create_connection(
+                ("127.0.0.1", srv.server_address[1]), timeout=10) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps(
+                {"model": "nope", "rows": [[1.0, 2.0]],
+                 "trace": ctx.to_wire()}) + "\n").encode())
+            f.flush()
+            reply = json.loads(f.readline())
+        assert reply["ok"] is False
+        assert reply["trace_id"] == ctx.trace_id
+    finally:
+        srv.shutdown()
+        d.stop(drain=False)
+
+
+def test_trace_assembled_event_lands_in_event_log(tmp_path):
+    set_event_logger(EventLogger(str(tmp_path), rank=0))
+    asm = SpanAssembler()
+    ctx = TraceContext.new(sampled=True)
+    asm.assemble(ctx.trace_id,
+                 [make_span(ctx.child(), "route", 1.0, 2.0)],
+                 model="m", outcome="ok")
+    set_event_logger(None)
+    events = [json.loads(ln) for ln in
+              open(tmp_path / "events-rank0.jsonl")]
+    ta = [e for e in events if e["event"] == "trace_assembled"]
+    assert len(ta) == 1 and ta[0]["trace_id"] == ctx.trace_id

@@ -31,8 +31,11 @@ from ..core import Finding, LintContext, Rule, register
 _SCOPE_PREFIXES = ("reliability",)
 # terminal-artifact writers outside reliability/: the flight recorder's
 # stall/crash/SIGUSR2 dumps are read by the same supervisor machinery
-# as the stall diagnosis, so they obey the same torn-file discipline
-_SCOPE_FILES = {"observability/flightrec.py"}
+# as the stall diagnosis, so they obey the same torn-file discipline;
+# the tracing layer joins the scope with it (assembled waterfalls ride
+# the same dump path and must never land torn)
+_SCOPE_FILES = {"observability/flightrec.py",
+                "observability/tracing.py"}
 _WRITE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b", "r+", "r+b", "rb+",
                 "x", "xb"}
 _ATOMIC_MARKERS = {"os.replace", "atomic_write_text",

@@ -32,7 +32,8 @@ CONSTRUCTORS = {"zeros": 2, "ones": 2, "full": 3, "arange": 4,
 SCOPE_DIRS = ("learner", "ops", "parallel", "inference", "serving")
 SCOPE_FILES = {os.path.join("io", "device_bin.py"),
                os.path.join("observability", "costmodel.py"),
-               os.path.join("observability", "watchdog.py")}
+               os.path.join("observability", "watchdog.py"),
+               os.path.join("observability", "tracing.py")}
 
 
 def _in_scope(pkg_rel: str) -> bool:

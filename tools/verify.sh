@@ -48,12 +48,19 @@ step "fleet suite (tests/test_fleet.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
 
+step "tracing + fleet observability suite (tests/test_tracing.py)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
+
 step "serving bench smoke (bench.py --serve --smoke)"
 JAX_PLATFORMS=cpu python bench.py --serve --smoke || fail=1
 
 step "fleet bench smoke (bench.py --serve-fleet --smoke)"
 # gates: zero lost client requests under an injected replica crash +
-# rolling publish + canary auto-rollback, router counters on /metrics
+# rolling publish + canary auto-rollback, router counters on /metrics;
+# ISSUE 14: merged fleet scrape == sum of per-replica scrapes (both
+# replicas contributing), >= 1 assembled cross-process trace, and the
+# serve_slow stall fires >= 1 slo_burn
 JAX_PLATFORMS=cpu python bench.py --serve-fleet --smoke || fail=1
 
 if [[ "${1:-}" != "--quick" ]]; then
