@@ -878,6 +878,390 @@ def serve_fleet_main(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def online_main(smoke: bool = False) -> int:
+    """Online continual-learning bench (docs/Online.md):
+    `python bench.py --online [--smoke]`.
+
+    Phase 1 (in-process, sustained load): an OnlineTrainer thread
+    consumes MemoryChunkSource generations — boosting new trees per
+    chunk, checkpointing each generation, hot-publishing into a local
+    ServingDaemon — while closed-loop client threads keep querying.
+    The chaos spec (`LGBM_TPU_FAULT=online_publish_fail@…,
+    online_chunk_corrupt@…`) drills the failure semantics mid-run: a
+    failed publish must retry and land (old generation serving
+    throughout), a corrupt chunk must be SKIPPED with the previous
+    generation serving.  Gates: ZERO lost client requests across all
+    publishes, every response byte-identical to `Booster.predict` of
+    the exact generation that served it, >= 3 generations published,
+    reported freshness lag finite and under `online_max_lag_s`.
+
+    Phase 2 (subprocess SIGTERM drill): a control `task=train-and-serve`
+    run consumes 3 on-disk chunks to completion; a drill run is
+    SIGTERM-killed mid-loop after generation 2, then relaunched — the
+    relaunch must resume from the generation-2 checkpoint, serve it
+    immediately (no served-version regression), re-train generation 3
+    BYTE-IDENTICALLY to the control run, and exit cleanly."""
+    backend_fallback = _ensure_jax_backend()
+    import jax
+    if backend_fallback:
+        jax.config.update("jax_platforms", "cpu")
+    _backend_guard()
+
+    import shutil
+    import signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.observability.registry import global_registry
+    from lightgbm_tpu.online import (LocalPublisher, MemoryChunkSource,
+                                     OnlineTrainer, write_chunk)
+    from lightgbm_tpu.reliability import faults
+    from lightgbm_tpu.serving import ServingClient, ServingDaemon
+    from lightgbm_tpu.serving.daemon import serve_counters_reset
+
+    n_rows = int(os.environ.get("BENCH_ONLINE_CHUNK_ROWS",
+                                1500 if smoke else 20000))
+    n_chunks = int(os.environ.get("BENCH_ONLINE_CHUNKS",
+                                  5 if smoke else 10))
+    n_threads = int(os.environ.get("BENCH_ONLINE_THREADS",
+                                   4 if smoke else 8))
+    req_rows = 4
+    max_lag_s = float(os.environ.get("BENCH_ONLINE_MAX_LAG_S", 60.0))
+    trees_per_chunk = 3
+
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, "device_predict": "true",
+              "device_predict_min_bucket": 64,
+              "serve_max_batch_rows": 256, "serve_queue_depth": 256,
+              "serve_max_coalesce_wait_ms": 2.0,
+              "metrics_port": 0,
+              "online_trees_per_chunk": trees_per_chunk,
+              "online_mode": "boost", "online_max_lag_s": max_lag_s,
+              "online_publish_backoff_ms": 25.0}
+
+    def mk_chunk(seed):
+        X, y = make_higgs_like(n_rows, FEATURES, seed=seed)
+        return X, y
+
+    workdir = tempfile.mkdtemp(prefix="lgbm-online-bench-")
+    failures: list = []
+    samples: list = []       # (version, start, preds) under lat_lock
+    lat_lock = threading.Lock()
+    versions_models: dict = {}
+
+    # chaos spec: publish of generation 2 fails once (must retry and
+    # land); chunk generation 4 arrives corrupt (must be skipped with
+    # generation 3 still serving)
+    chaos = os.environ.get("BENCH_ONLINE_FAULT",
+                           "online_publish_fail@2,online_chunk_corrupt@4")
+    prev_fault = os.environ.get("LGBM_TPU_FAULT")
+    corrupt_gens = {int(tok.split("@")[1]) for tok in chaos.split(",")
+                    if tok.startswith("online_chunk_corrupt@")}
+    try:
+        serve_counters_reset()
+        for key in ("online_generations_published",
+                    "online_generations_skipped",
+                    "online_publish_retries"):
+            global_registry.inc(key, -global_registry.counter(key))
+        if chaos:
+            os.environ["LGBM_TPU_FAULT"] = chaos
+        else:
+            os.environ.pop("LGBM_TPU_FAULT", None)
+        faults.reload()
+
+        X0, y0 = mk_chunk(0)
+        seed_booster = lgb.train(
+            {k: v for k, v in params.items()
+             if not k.startswith(("serve_", "online_", "metrics_"))},
+            lgb.Dataset(X0, label=y0), num_boost_round=10)
+        seed_path = os.path.join(workdir, "seed.txt")
+        seed_booster.save_model(seed_path)
+
+        daemon = ServingDaemon(Config(params)).start()
+        source = MemoryChunkSource()
+        ckpt_dir = os.path.join(workdir, "ckpt")
+
+        def on_publish(gen, version, model_str):
+            with lat_lock:
+                versions_models[version] = model_str
+
+        trainer = OnlineTrainer(source, LocalPublisher(daemon),
+                                params=params, checkpoint_dir=ckpt_dir,
+                                seed_model=seed_path,
+                                on_publish=on_publish)
+        trainer.start()
+
+        pool, _ = make_higgs_like(2048, FEATURES, seed=99)
+        pool = np.ascontiguousarray(pool, np.float32)
+        stop_flag = threading.Event()
+
+        def client(tid):
+            rnd = 0
+            while not stop_flag.is_set():
+                rnd += 1
+                start = ((tid * 2654435761 + rnd * 97)
+                         % (len(pool) - req_rows))
+                try:
+                    fut = daemon.submit(trainer.model_name,
+                                        pool[start:start + req_rows])
+                    out = fut.result(timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    with lat_lock:
+                        failures.append(f"t{tid}r{rnd}: {e!r}")
+                    time.sleep(0.05)
+                    continue
+                with lat_lock:
+                    samples.append((fut.version, start,
+                                    np.asarray(out)))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        loop = threading.Thread(
+            target=lambda: trainer.run(max_generations=n_chunks,
+                                       idle_exit_s=60.0), daemon=True)
+        loop.start()
+        for g in range(1, n_chunks + 1):
+            source.push(*mk_chunk(g))
+            time.sleep(0.3 if smoke else 1.0)
+        loop.join(timeout=600)
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=60)
+        wall = time.time() - t0
+        stats = trainer.stats()
+        if loop.is_alive():
+            failures.append("trainer loop did not finish")
+
+        # byte-identity: every sampled response must equal
+        # Booster.predict of the exact version that served it (device
+        # path forced: the daemon serves through the same float32
+        # traversal, so the comparison is bit-for-bit)
+        def _oracle(model_str):
+            b = Booster(model_str=model_str)
+            b._gbdt.config.device_predict = "true"
+            return b
+
+        with lat_lock:
+            model_of = {v: _oracle(s)
+                        for v, s in versions_models.items()}
+        expected = {v: b.predict(pool) for v, b in model_of.items()}
+        mismatches = 0
+        for version, start, preds in samples:
+            exp = expected.get(version)
+            if exp is None or not np.array_equal(
+                    preds, exp[start:start + req_rows]):
+                mismatches += 1
+        if mismatches:
+            failures.append(f"{mismatches} responses not byte-identical "
+                            "to their serving generation")
+
+        published = int(global_registry.counter(
+            "online_generations_published"))
+        skipped = int(global_registry.counter(
+            "online_generations_skipped"))
+        retries = int(global_registry.counter("online_publish_retries"))
+        lag = stats.get("freshness_lag_s")
+        lag_ok = lag is not None and np.isfinite(lag) and lag <= max_lag_s
+
+        # the freshness plane must be scrapable (docs/Online.md)
+        metrics_scrape_ok = False
+        scrape_error = None
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.metrics_server.port}/metrics",
+                timeout=30).read().decode()
+            required = ["lgbm_model_freshness_lag_s",
+                        "lgbm_online_generations_published",
+                        "lgbm_online_generation"]
+            if skipped:
+                required.append("lgbm_online_generations_skipped")
+            missing = [r for r in required if r not in page]
+            if missing:
+                scrape_error = f"missing series: {missing}"
+            else:
+                metrics_scrape_ok = True
+        except Exception as e:  # noqa: BLE001 - reported in the JSON line
+            scrape_error = str(e)
+        daemon.stop(drain=True, timeout=30)
+    finally:
+        if prev_fault is None:
+            os.environ.pop("LGBM_TPU_FAULT", None)
+        else:
+            os.environ["LGBM_TPU_FAULT"] = prev_fault
+        faults.reload()
+
+    # ---- phase 2: the SIGTERM kill/resume drill (subprocesses) ----
+    drill = {"control_rc": None, "kill_rc": None, "resume_rc": None,
+             "byte_exact": None, "served_no_regress": None,
+             "error": None}
+    try:
+        chunks_a = os.path.join(workdir, "chunks-a")
+        chunks_b = os.path.join(workdir, "chunks-b")
+        os.makedirs(chunks_a)
+        os.makedirs(chunks_b)
+        drill_chunks = {}
+        for g in (1, 2, 3):
+            Xg, yg = mk_chunk(100 + g)
+            drill_chunks[g] = write_chunk(chunks_a, g, Xg, yg)
+        base_cmd = [sys.executable, "-m", "lightgbm_tpu",
+                    "task=train-and-serve",
+                    "objective=binary", "num_leaves=15", "verbosity=-1",
+                    "min_data_in_leaf=10", "device_predict=true",
+                    "device_predict_min_bucket=64", "serve_warmup=false",
+                    "online_mode=boost", "online_trees_per_chunk=2",
+                    "online_poll_interval_s=0.05",
+                    f"input_model={seed_path}"]
+        env = {k: v for k, v in os.environ.items()
+               if k != "LGBM_TPU_FAULT"}
+        env.setdefault("JAX_PLATFORMS", "cpu")
+
+        ck_a = os.path.join(workdir, "ckpt-a")
+        res = subprocess.run(
+            base_cmd + [f"online_chunk_dir={chunks_a}",
+                        f"checkpoint_dir={ck_a}", "serve_port=-1",
+                        "online_idle_exit_s=1.5"],
+            capture_output=True, text=True, timeout=600, env=env)
+        drill["control_rc"] = res.returncode
+        control_final = open(os.path.join(ck_a, "ckpt_0000003.txt"),
+                             "rb").read()
+        control_g2 = open(os.path.join(ck_a, "ckpt_0000002.txt"),
+                          "rb").read()
+
+        # drill run: only generations 1-2 available, killed mid-loop
+        for g in (1, 2):
+            shutil.copy(drill_chunks[g], chunks_b)
+        ck_b = os.path.join(workdir, "ckpt-b")
+        ready1 = os.path.join(workdir, "ready-b1.json")
+        child = subprocess.Popen(
+            base_cmd + [f"online_chunk_dir={chunks_b}",
+                        f"checkpoint_dir={ck_b}", "serve_port=-1",
+                        "online_idle_exit_s=0",
+                        f"serve_ready_file={ready1}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if os.path.exists(os.path.join(ck_b, "ckpt_0000002.txt")):
+                break
+            if child.poll() is not None:
+                break
+            time.sleep(0.1)
+        time.sleep(0.3)  # let the generation-2 publish settle
+        child.send_signal(signal.SIGTERM)
+        try:
+            out_b1, _ = child.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            out_b1, _ = child.communicate()
+        drill["kill_rc"] = child.returncode
+
+        # relaunch with generation 3 landed: must resume from the
+        # generation-2 checkpoint, serve it immediately, and re-train
+        # generation 3 byte-identically to the control run
+        shutil.copy(drill_chunks[3], chunks_b)
+        ready2 = os.path.join(workdir, "ready-b2.json")
+        child2 = subprocess.Popen(
+            base_cmd + [f"online_chunk_dir={chunks_b}",
+                        f"checkpoint_dir={ck_b}", "serve_port=0",
+                        "online_idle_exit_s=1.5",
+                        f"serve_ready_file={ready2}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        deadline = time.time() + 300
+        port = None
+        while time.time() < deadline and port is None:
+            if os.path.exists(ready2):
+                port = json.load(open(ready2)).get("port")
+                break
+            if child2.poll() is not None:
+                break
+            time.sleep(0.1)
+        served_ok = None
+        if port and port > 0:
+            # the ready file lands right after the RESUME publish: the
+            # served model must already be generation >= 2 — never the
+            # seed (that would regress the fleet below its checkpoint)
+            exp_g2 = _oracle(control_g2.decode()).predict(
+                pool[:req_rows])
+            exp_g3 = _oracle(control_final.decode()).predict(
+                pool[:req_rows])
+            try:
+                cl = ServingClient.connect("127.0.0.1", int(port),
+                                           request_timeout_s=60.0)
+                got = np.asarray(cl.predict("online", pool[:req_rows]))
+                cl.close()
+                served_ok = (np.array_equal(got, exp_g2)
+                             or np.array_equal(got, exp_g3))
+            except Exception as e:  # noqa: BLE001
+                served_ok = False
+                drill["error"] = f"resume probe: {e!r}"
+        drill["served_no_regress"] = served_ok
+        try:
+            out_b2, _ = child2.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            child2.kill()
+            out_b2, _ = child2.communicate()
+        drill["resume_rc"] = child2.returncode
+        resumed_final_path = os.path.join(ck_b, "ckpt_0000003.txt")
+        if os.path.exists(resumed_final_path):
+            drill["byte_exact"] = (open(resumed_final_path, "rb").read()
+                                   == control_final)
+        else:
+            drill["byte_exact"] = False
+            drill["error"] = (drill["error"] or "") + \
+                f" no resumed gen-3 checkpoint; b2 tail: {out_b2[-500:]}"
+    except Exception as e:  # noqa: BLE001 - drill outcome rides the JSON line
+        drill["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    drill_ok = (drill["control_rc"] == 0
+                and drill["kill_rc"] in (143, -15)
+                and drill["resume_rc"] == 0
+                and drill["byte_exact"] is True
+                and drill["served_no_regress"] is True)
+    chaos_ok = (not chaos) or (retries >= 1 and skipped >= 1
+                               and skipped == len(corrupt_gens))
+    out = {
+        "metric": "online_continual",
+        "value": (round(lag, 3) if lag is not None else None),
+        "unit": "freshness_lag_s",
+        "generations_published": published,
+        "generations_skipped": skipped,
+        "publish_retries": retries,
+        "freshness_lag_s": (round(lag, 4) if lag is not None else None),
+        "freshness_lag_ok": bool(lag_ok),
+        "online_max_lag_s": max_lag_s,
+        "requests_ok": len(samples),
+        "requests_failed": len(failures),
+        "requests_per_s": round(len(samples) / max(wall, 1e-9), 1),
+        "chunk_rows": n_rows,
+        "chunks": n_chunks,
+        "versions_served": sorted({v for v, _, _ in samples}),
+        "chaos_spec": chaos or None,
+        "chaos_ok": bool(chaos_ok),
+        "metrics_scrape_ok": bool(metrics_scrape_ok),
+        "metrics_scrape_error": scrape_error,
+        "sigterm_drill": drill,
+        "sigterm_drill_ok": bool(drill_ok),
+        "errors": failures[:5],
+        "backend": jax.default_backend(),
+        "smoke": bool(smoke),
+    }
+    print(json.dumps(out))
+    ok = (not failures and published >= 3 and lag_ok and chaos_ok
+          and metrics_scrape_ok and drill_ok
+          and len(samples) > 0)
+    return 0 if ok else 1
+
+
 _MULTICHIP_CHILD = r"""
 import os, sys
 sys.path.insert(0, os.environ["BENCH_REPO"])
@@ -1327,4 +1711,6 @@ if __name__ == "__main__":
         sys.exit(serve_main(smoke="--smoke" in sys.argv[2:]))
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve-fleet":
         sys.exit(serve_fleet_main(smoke="--smoke" in sys.argv[2:]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--online":
+        sys.exit(online_main(smoke="--smoke" in sys.argv[2:]))
     main()

@@ -196,9 +196,15 @@ def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
     daemon.start()
     daemon.install_signal_handlers()
     srv = None
+    uds_srv = None
     if cfg.serve_port >= 0:
         srv = start_frontend(daemon, port=cfg.serve_port,
                              request_timeout_s=cfg.serve_request_timeout_s)
+    if cfg.serve_uds_path:
+        from .serving import start_uds_frontend
+        uds_srv = start_uds_frontend(
+            daemon, cfg.serve_uds_path,
+            request_timeout_s=cfg.serve_request_timeout_s)
     if cfg.serve_ready_file:
         # readiness marker for the fleet supervisor: port + pid land
         # atomically only AFTER every model is loaded, warmed, and the
@@ -224,6 +230,8 @@ def _task_serve(cfg: Config, params: Dict[str, str]) -> None:
     finally:
         if srv is not None:
             srv.shutdown()
+        if uds_srv is not None:
+            uds_srv.shutdown()
 
 
 def _task_serve_fleet(cfg: Config, params: Dict[str, str]) -> None:
@@ -330,6 +338,118 @@ def _task_serve_fleet(cfg: Config, params: Dict[str, str]) -> None:
         router.stop()
 
 
+def _task_train_and_serve(cfg: Config, params: Dict[str, str]) -> None:
+    """Online continual learning (docs/Online.md):
+    `python -m lightgbm_tpu task=train-and-serve online_chunk_dir=DIR
+    checkpoint_dir=CKPT [input_model=seed.txt] [serve_port=0]`.
+
+    One process closing the train->serve loop: a DirectoryChunkSource
+    watches `online_chunk_dir`, the OnlineTrainer boosts/refits per
+    chunk generation, checkpoints each generation (byte-exact
+    SIGTERM/crash resume), and publishes atomically — into this
+    process's own serving daemon (default; serve_port/serve_uds_path
+    expose it), or over the wire to a remote router/replica when
+    `online_publish_addr=host:port` is set.  SIGTERM stops the loop at
+    the next boundary (mid-generation: the relaunch resumes from the
+    last completed generation's checkpoint) and drains the local
+    daemon; exit stays 143."""
+    import json as _json
+    import time as _time
+
+    from .online import (DirectoryChunkSource, LocalPublisher,
+                         OnlineTrainer, WirePublisher)
+
+    if cfg.metrics_dir:
+        from .observability import set_event_logger
+        from .observability.events import EventLogger
+        set_event_logger(EventLogger(cfg.metrics_dir,
+                                     rotate_mb=cfg.metrics_rotate_mb))
+        from .reliability.faults import register_flight_dump_signal
+        register_flight_dump_signal(cfg.metrics_dir)
+    if not cfg.online_chunk_dir:
+        log.fatal("task=train-and-serve needs online_chunk_dir=<dir>")
+    if not cfg.checkpoint_dir:
+        log.warning("train-and-serve without checkpoint_dir=: a restart "
+                    "re-trains from scratch (no byte-exact resume)")
+
+    daemon = None
+    srv = None
+    uds_srv = None
+    if cfg.online_publish_addr:
+        host, _, port = cfg.online_publish_addr.rpartition(":")
+        if not port.isdigit():
+            log.fatal(f"online_publish_addr must be host:port "
+                      f"(got {cfg.online_publish_addr!r})")
+        publisher = WirePublisher(host or "127.0.0.1", int(port))
+        log.info(f"Publishing generations to {cfg.online_publish_addr} "
+                 "(op=publish over the wire)")
+    else:
+        from .serving import ServingDaemon, start_frontend, \
+            start_uds_frontend
+        daemon = ServingDaemon(cfg).start()
+        publisher = LocalPublisher(daemon)
+        if cfg.serve_port >= 0:
+            srv = start_frontend(
+                daemon, port=cfg.serve_port,
+                request_timeout_s=cfg.serve_request_timeout_s)
+        if cfg.serve_uds_path:
+            uds_srv = start_uds_frontend(
+                daemon, cfg.serve_uds_path,
+                request_timeout_s=cfg.serve_request_timeout_s)
+
+    source = DirectoryChunkSource(cfg.online_chunk_dir)
+    trainer = OnlineTrainer(source, publisher, config=cfg,
+                            params=dict(params),
+                            checkpoint_dir=cfg.checkpoint_dir or None,
+                            seed_model=cfg.input_model or None)
+    trainer.install_signal_handlers()
+    if daemon is not None:
+        # one preemption-hook slot: the trainer owns it; chain the
+        # daemon's drain behind the loop-stop so a SIGTERM between
+        # generations completes queued requests before the exit
+        from .observability import set_preemption_hook
+
+        def _stop_all():
+            trainer.request_stop()
+            daemon.stop(drain=True, timeout=cfg.serve_drain_timeout_s)
+            return None  # finish_preemption re-delivers; rc stays 143
+
+        set_preemption_hook(_stop_all)
+    trainer.start()  # resume (or seed) + initial publish
+    if cfg.serve_ready_file:
+        from .utils import atomic_write_text
+        atomic_write_text(cfg.serve_ready_file, _json.dumps({
+            "pid": os.getpid(),
+            "port": (srv.server_address[1] if srv is not None else -1),
+            "uds_path": cfg.serve_uds_path or None,
+            "metrics_port": (daemon.metrics_server.port
+                             if daemon is not None
+                             and daemon.metrics_server else -1),
+            "generation": trainer.generation,
+            "model": trainer.model_name}))
+        log.info(f"Ready file written to {cfg.serve_ready_file}")
+    log.info(f"Online loop watching {cfg.online_chunk_dir} "
+             f"(mode={cfg.online_mode}, "
+             f"{cfg.online_trees_per_chunk} trees/chunk"
+             + (f", freshness SLO {cfg.online_max_lag_s:g}s"
+                if cfg.online_max_lag_s > 0 else "") + ")")
+    try:
+        stats = trainer.run()
+        log.info(f"Online loop finished: {stats}")
+    except KeyboardInterrupt:
+        log.info("Interrupted; stopping the online loop")
+        trainer.request_stop()
+    finally:
+        if daemon is not None and not daemon.stopped:
+            daemon.stop(drain=True, timeout=cfg.serve_drain_timeout_s)
+        if srv is not None:
+            srv.shutdown()
+        if uds_srv is not None:
+            uds_srv.shutdown()
+        # give the last published generation a beat to settle in logs
+        _time.sleep(0.0)
+
+
 def _task_convert_model(cfg: Config, params: Dict[str, str]) -> None:
     """Model -> standalone C-like if-else source
     (ref: gbdt_model_text.cpp SaveModelToIfElse)."""
@@ -417,7 +537,7 @@ def _maybe_init_distributed(cfg: Config) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] in ("serve", "serve-fleet"):
+    if argv and argv[0] in ("serve", "serve-fleet", "train-and-serve"):
         # `python -m lightgbm_tpu serve[-fleet] k=v ...` sugar
         argv = [f"task={argv[0]}"] + list(argv[1:])
     params = parse_args(argv)
@@ -431,6 +551,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "serve": _task_serve,
                 "serve-fleet": _task_serve_fleet,
                 "serve_fleet": _task_serve_fleet,
+                "train-and-serve": _task_train_and_serve,
+                "train_and_serve": _task_train_and_serve,
                 "convert_model": _task_convert_model}
     if task not in handlers:
         log.fatal(f"Unknown task {task!r}")

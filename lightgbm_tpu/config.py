@@ -350,6 +350,47 @@ PARAMS: List[Tuple[str, str, Any, Tuple[str, ...]]] = [
     # once every model is warmed and the front end is listening — the
     # fleet supervisor discovers replica ports through it
     ("serve_ready_file", "str", "", ()),
+    # adaptive request coalescing (docs/Serving.md): "auto" derives the
+    # per-batch wait window from an EWMA of request inter-arrival gaps —
+    # it never exceeds the static serve_max_coalesce_wait_ms, and it
+    # shrinks to 0 when arrivals are sparse (nobody else is coming
+    # inside the window, so waiting only buys p50); "off" keeps the
+    # static window unconditionally
+    ("serve_adaptive_coalesce", "str", "off", ()),
+    # Unix-domain-socket front end (docs/Serving.md): the same line-JSON
+    # wire as serve_port, served on a filesystem socket — no TCP stack,
+    # no port allocation, natural for same-host sidecars ("" = off)
+    ("serve_uds_path", "str", "", ()),
+    # --- online continual learning (docs/Online.md) ---
+    # directory the train-and-serve task watches for chunk files
+    # (chunk-<generation>.npz/.npy/.csv, atomically renamed into place)
+    ("online_chunk_dir", "str", "", ("chunk_dir",)),
+    # per-chunk update: "boost" = continue training
+    # online_trees_per_chunk new trees via init_model, "refit" =
+    # re-estimate the existing leaves on the fresh chunk, "auto" = refit
+    # when the chunk has fewer rows than the ensemble has trees
+    ("online_mode", "str", "auto", ()),
+    ("online_trees_per_chunk", "int", 5, ()),
+    # chunk-source poll cadence of the online loop
+    ("online_poll_interval_s", "float", 0.25, ()),
+    # name each generation publishes under in the serving registry/fleet
+    ("online_model_name", "str", "online", ()),
+    # model-freshness SLO (chunk arrival -> first request served by a
+    # model that saw it): generations whose lag exceeds this feed the
+    # burn-rate tracker (0 = freshness SLO off, lag still measured)
+    ("online_max_lag_s", "float", 0.0, ()),
+    # publish retry budget per generation: a failed publish keeps the
+    # previous generation serving and retries with backoff
+    ("online_publish_retry_max", "int", 3, ()),
+    ("online_publish_backoff_ms", "float", 50.0, ()),
+    # publish over the wire (op=publish) to a remote router/replica at
+    # host:port instead of the task's own local serving daemon
+    ("online_publish_addr", "str", "", ()),
+    # stop after this many chunk generations (0 = run until SIGTERM)
+    ("online_max_generations", "int", 0, ()),
+    # exit cleanly when no new chunk arrives for this long (0 = never;
+    # the drill/bench knob that makes a bounded run deterministic)
+    ("online_idle_exit_s", "float", 0.0, ()),
     # --- fleet SLO tracking (docs/Observability.md "Fleet metrics &
     # SLO"): router-observed request outcomes feed a multi-window
     # burn-rate computation; both windows over threshold emits one
@@ -557,6 +598,18 @@ class Config:
             log.fatal(f"device_eval must be auto, true or false "
                       f"(got {self.device_eval!r})")
         self.device_eval = de
+        om = str(self.online_mode).strip().lower()
+        if om not in ("auto", "boost", "refit"):
+            log.fatal(f"online_mode must be auto, boost or refit "
+                      f"(got {self.online_mode!r})")
+        self.online_mode = om
+        ac = str(self.serve_adaptive_coalesce).strip().lower()
+        ac = {"1": "auto", "true": "auto", "yes": "auto",
+              "0": "off", "false": "off", "no": "off"}.get(ac, ac)
+        if ac not in ("auto", "off"):
+            log.fatal(f"serve_adaptive_coalesce must be auto or off "
+                      f"(got {self.serve_adaptive_coalesce!r})")
+        self.serve_adaptive_coalesce = ac
 
     def to_dict(self) -> Dict[str, Any]:
         return {name: getattr(self, name) for name, _, _, _ in PARAMS}

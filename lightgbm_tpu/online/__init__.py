@@ -1,0 +1,29 @@
+"""Online continual learning: the closed train->serve loop
+(docs/Online.md).
+
+A `ChunkSource` sequences arriving row chunks with monotone generation
+ids; the `OnlineTrainer` consumes them — boosting additional trees via
+init_model continuation or refitting leaf values on the fresh chunk —
+checkpoints every generation through the existing CheckpointManager
+(byte-exact SIGTERM/crash resume), and publishes each generation
+atomically into serving (local ModelRegistry hot swap, in-process
+Router rolling/canary rollout, or `op=publish` over the wire) while the
+previous generation keeps serving.  The freshness plane measures
+`model_freshness_lag_s` (chunk arrival -> first request served by a
+model that saw it) against the `online_max_lag_s` SLO.
+
+`python -m lightgbm_tpu task=train-and-serve` is the CLI front end;
+`bench.py --online` the closed-loop bench with the SIGTERM drill.
+"""
+
+from .chunks import (Chunk, ChunkSource, DirectoryChunkSource,
+                     MemoryChunkSource, write_chunk)
+from .trainer import (LocalPublisher, OnlineTrainer, PublishError,
+                      RouterPublisher, WirePublisher)
+
+__all__ = [
+    "Chunk", "ChunkSource", "DirectoryChunkSource", "MemoryChunkSource",
+    "write_chunk",
+    "LocalPublisher", "OnlineTrainer", "PublishError", "RouterPublisher",
+    "WirePublisher",
+]

@@ -79,6 +79,16 @@ _HASH_EXCLUDE = frozenset((
     # fleet SLO / tracing knobs (docs/Observability.md): telemetry only
     "serve_slo_p99_ms", "serve_slo_error_pct", "serve_slo_fast_window_s",
     "serve_slo_slow_window_s", "serve_slo_burn_threshold",
+    "serve_trace_sample", "serve_adaptive_coalesce", "serve_uds_path",
+    # online continual-learning loop knobs (docs/Online.md): the chunk
+    # cadence, publish topology and freshness SLO never change what a
+    # given (model text, chunk bytes) pair trains into — a checkpoint
+    # must resume across any of them (the SIGTERM drill relaunches with
+    # a different online_idle_exit_s, for one)
+    "online_chunk_dir", "online_mode", "online_trees_per_chunk",
+    "online_poll_interval_s", "online_model_name", "online_max_lag_s",
+    "online_publish_retry_max", "online_publish_backoff_ms",
+    "online_publish_addr", "online_max_generations", "online_idle_exit_s",
     # the degradation ladder (reliability/guard.py) flips these between
     # attempts; all are model-neutral perf/telemetry knobs, and a
     # degraded relaunch MUST still resume the interrupted checkpoint

@@ -45,6 +45,19 @@ tests drill the router's retry/shed/restart paths with them:
   dispatch: latency injection on the dispatcher thread, the shape a
   wedged device presents to the frontend (queue backs up -> shed)
 
+Online-loop fault points (docs/Online.md failure semantics): the `@N`
+matches the CHUNK GENERATION id the online trainer is processing:
+
+* `online_chunk_corrupt@N` — damage chunk generation N before the
+  trainer reads it: an on-disk chunk is truncated in place (the read
+  that follows fails, the torn-upload shape); an in-memory chunk is
+  poisoned via the True return.  The trainer must SKIP the generation
+  (counted `online_generations_skipped`) and keep the previous
+  generation serving
+* `online_publish_fail@N`  — raise from the publish of generation N:
+  the trainer must keep the old generation serving and retry with
+  backoff — a half-published model must never serve
+
 Rank gating applies to replicas too: the fleet sets
 `LGBM_TPU_FAULT_SELF_RANK` to each replica's index, so
 `LGBM_TPU_FAULT_RANK=1` drills exactly one replica of a fleet.
@@ -79,7 +92,8 @@ _specs: Optional[List[Tuple[str, int, int]]] = None
 _KINDS = ("worker_crash", "nan_grad", "ckpt_write_fail",
           "hang", "slow_iter", "collective_stall",
           "ckpt_corrupt", "worker_lost",
-          "serve_crash", "serve_shed", "serve_slow")
+          "serve_crash", "serve_shed", "serve_slow",
+          "online_chunk_corrupt", "online_publish_fail")
 
 
 def _parse() -> List[Tuple[str, int, int]]:
@@ -266,6 +280,42 @@ def consume_serve_slow() -> None:
     if dur > 0:
         import time
         time.sleep(dur)
+
+
+def maybe_online_chunk_corrupt(generation: int,
+                               path: Optional[str] = None) -> bool:
+    """online_chunk_corrupt hook (online chunk sources, per generation):
+    models a torn upload / bad-sector chunk.  An on-disk chunk is
+    truncated in place so the read that follows fails exactly like real
+    damage; an in-memory chunk has no bytes to damage, so the True
+    return poisons it.  The trainer's contract either way: skip the
+    generation, keep the previous one serving."""
+    if not _should_fire("online_chunk_corrupt", generation):
+        return False
+    _record_injection("online_chunk_corrupt", generation)
+    if path:
+        try:
+            size = os.path.getsize(path)
+            # tpulint: disable-next=atomic-write-discipline -- fault injection: deliberate in-place truncation models the torn chunk upload the source's read validation must catch
+            with open(path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+        except OSError as e:
+            log.warning(f"[LGBM_TPU_FAULT] online_chunk_corrupt could not "
+                        f"damage {path}: {e}")
+    log.warning(f"[LGBM_TPU_FAULT] injected online_chunk_corrupt at "
+                f"generation {generation}")
+    return True
+
+
+def maybe_online_publish_fail(generation: int) -> None:
+    """online_publish_fail hook (online trainer, before the publish of
+    one generation): the publish raises, the trainer must keep the old
+    generation serving and retry — never serve a half-published
+    model."""
+    if _should_fire("online_publish_fail", generation):
+        _record_injection("online_publish_fail", generation)
+        raise RuntimeError(f"[LGBM_TPU_FAULT] injected online_publish_fail "
+                           f"at generation {generation}")
 
 
 def register_stack_dump_signal() -> bool:

@@ -19,7 +19,8 @@ from .coalescer import Coalescer, ServeFuture, ServeRequest, ShedError
 from .daemon import ServingClient, ServingDaemon, serve_counters_reset
 from .fleet import (FleetAggregator, ReplicaEndpoint, ReplicaFleet,
                     ReplicaState)
-from .frontend import LineClient, ServeFrontend, start_frontend
+from .frontend import (LineClient, ServeFrontend, ServeUdsFrontend,
+                       start_frontend, start_uds_frontend)
 from .registry import LoadHandle, ModelEntry, ModelRegistry
 from .router import (NoReplicaError, OverloadedError, Router, RouterReply,
                      start_router_frontend)
@@ -28,7 +29,8 @@ __all__ = [
     "Coalescer", "ServeFuture", "ServeRequest", "ShedError",
     "ServingClient", "ServingDaemon", "serve_counters_reset",
     "FleetAggregator", "ReplicaEndpoint", "ReplicaFleet", "ReplicaState",
-    "LineClient", "ServeFrontend", "start_frontend",
+    "LineClient", "ServeFrontend", "ServeUdsFrontend", "start_frontend",
+    "start_uds_frontend",
     "LoadHandle", "ModelEntry", "ModelRegistry",
     "NoReplicaError", "OverloadedError", "Router", "RouterReply",
     "start_router_frontend",

@@ -142,14 +142,29 @@ class Coalescer:
     """One dispatcher thread merging queued requests into bucket
     dispatches (docs/Serving.md)."""
 
+    # EWMA weight of the newest inter-arrival gap (adaptive mode): ~10
+    # arrivals of history, enough to ride out one odd gap without
+    # lagging a real load change by more than a few requests
+    _EWMA_ALPHA = 0.2
+
     def __init__(self, max_wait_ms: float = 2.0, queue_depth: int = 1024,
                  max_batch_rows: int = 65536,
                  latency_window: Optional[LatencyWindow] = None,
-                 trace_sample: int = 0):
+                 trace_sample: int = 0, adaptive: bool = False):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(int(queue_depth), 1))
         self._max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
         self._max_rows = max(int(max_batch_rows), 1)
         self._window = latency_window
+        # adaptive coalescing (serve_adaptive_coalesce=auto): track an
+        # EWMA of request inter-arrival gaps at submit and derive the
+        # per-batch wait from it — capped at the static window under
+        # burst (batch shapes unchanged), shrunk to 0 when arrivals are
+        # sparse (nobody else is coming inside the window, so waiting
+        # would only buy p50).  Guarded by self._lock: submit threads
+        # write, the dispatcher reads.
+        self._adaptive = bool(adaptive)
+        self._ewma_gap_s: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         # flight-recorder request tracing: every `trace_sample`-th
         # request gets a full enqueue->coalesce->dispatch->settle->
         # respond stage record (0 = off); only touched by the dispatcher
@@ -194,6 +209,14 @@ class Coalescer:
         another replica while the deadline still has budget."""
         with self._lock:
             closing = self._closing or self._thread is None
+            if not closing and self._adaptive:
+                now = time.monotonic()
+                if self._last_arrival is not None:
+                    gap = now - self._last_arrival
+                    self._ewma_gap_s = gap if self._ewma_gap_s is None \
+                        else ((1.0 - self._EWMA_ALPHA) * self._ewma_gap_s
+                              + self._EWMA_ALPHA * gap)
+                self._last_arrival = now
         if closing:
             raise RuntimeError("Serving daemon is not accepting requests "
                                "(stopped or draining)")
@@ -287,6 +310,22 @@ class Coalescer:
     def pending(self) -> int:
         return self._q.qsize()
 
+    def effective_wait_s(self) -> float:
+        """The wait window for the NEXT batch.  Static mode: the
+        configured window unconditionally.  Adaptive mode: arrivals
+        coming faster than the window (EWMA gap <= window) keep the FULL
+        static window — burst batches coalesce exactly as before — while
+        sparse arrivals (EWMA gap beyond the window, or no history yet)
+        shrink it to 0: the expected next arrival misses the window
+        anyway, so waiting only inflates p50 (docs/Serving.md)."""
+        if not self._adaptive:
+            return self._max_wait_s
+        with self._lock:
+            gap = self._ewma_gap_s
+        if gap is None or gap > self._max_wait_s:
+            return 0.0
+        return self._max_wait_s
+
     # --------------------------------------------------------------- worker
     def _loop(self) -> None:
         while True:
@@ -301,8 +340,9 @@ class Coalescer:
             first.t_coalesce = time.monotonic()
             batch = [first]
             rows = first.n
-            if self._max_wait_s > 0 and not self._stop.is_set():
-                deadline = time.monotonic() + self._max_wait_s
+            wait_s = self.effective_wait_s()
+            if wait_s > 0 and not self._stop.is_set():
+                deadline = time.monotonic() + wait_s
                 while rows < self._max_rows:
                     rem = deadline - time.monotonic()
                     if rem <= 0:

@@ -97,7 +97,8 @@ class ServingDaemon:
             queue_depth=config.serve_queue_depth,
             max_batch_rows=config.serve_max_batch_rows,
             latency_window=self.latency,
-            trace_sample=config.serve_trace_sample)
+            trace_sample=config.serve_trace_sample,
+            adaptive=config.serve_adaptive_coalesce == "auto")
         self._stopped = threading.Event()
         self.metrics_server = None
         # compiled-cost roofline accounting (costmodel.py): enabled for
@@ -323,10 +324,12 @@ class ServingClient:
                  address: Optional[Tuple[str, int]] = None,
                  request_timeout_s: float = 60.0,
                  retry_backoff_ms: float = 25.0,
-                 trace_sample: int = 0):
-        if (daemon is None) == (address is None):
+                 trace_sample: int = 0,
+                 uds_path: Optional[str] = None):
+        if sum(x is not None for x in (daemon, address, uds_path)) != 1:
             raise ValueError("ServingClient needs exactly one of daemon= "
-                             "(in-process) or address= (TCP)")
+                             "(in-process), address= (TCP) or uds_path= "
+                             "(Unix socket)")
         self._daemon = daemon
         self._conn = None
         self._timeout_s = float(request_timeout_s)
@@ -335,10 +338,14 @@ class ServingClient:
         self._trace_seq = 0
         self.last_trace_id: Optional[str] = None
         self.last_spans = None
-        if address is not None:
+        if address is not None or uds_path is not None:
             from .frontend import LineClient
-            self._conn = LineClient(address[0], int(address[1]),
-                                    backoff_ms=retry_backoff_ms)
+            if address is not None:
+                self._conn = LineClient(address[0], int(address[1]),
+                                        backoff_ms=retry_backoff_ms)
+            else:
+                self._conn = LineClient(uds_path=uds_path,
+                                        backoff_ms=retry_backoff_ms)
             self._conn_lock = threading.Lock()
 
     @classmethod
@@ -348,6 +355,18 @@ class ServingClient:
                 trace_sample: int = 0) -> "ServingClient":
         """TCP client for a daemon's front end (`serve_port`)."""
         return cls(address=(host, port),
+                   request_timeout_s=request_timeout_s,
+                   retry_backoff_ms=retry_backoff_ms,
+                   trace_sample=trace_sample)
+
+    @classmethod
+    def connect_uds(cls, path: str,
+                    request_timeout_s: float = 60.0,
+                    retry_backoff_ms: float = 25.0,
+                    trace_sample: int = 0) -> "ServingClient":
+        """Unix-socket client for a daemon's UDS front end
+        (`serve_uds_path`) — same wire, same semantics as TCP."""
+        return cls(uds_path=path,
                    request_timeout_s=request_timeout_s,
                    retry_backoff_ms=retry_backoff_ms,
                    trace_sample=trace_sample)

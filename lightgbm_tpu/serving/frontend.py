@@ -41,6 +41,7 @@ Fleet semantics (ISSUE 13):
 from __future__ import annotations
 
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -164,6 +165,15 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class ServeUdsFrontend(socketserver.ThreadingUnixStreamServer):
+    """The SAME line-JSON wire over a Unix domain socket
+    (`serve_uds_path`): one handler class serves both transports, so
+    predict/publish/health/metrics/trace behave identically — no TCP
+    stack, no port allocation, natural for same-host sidecars."""
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 def start_frontend(daemon, port: int = 0, host: str = "127.0.0.1",
                    request_timeout_s: float = 60.0) -> ServeFrontend:
     """Bind (port 0 = ephemeral) and serve on a background thread.
@@ -182,6 +192,29 @@ def start_frontend(daemon, port: int = 0, host: str = "127.0.0.1",
     return srv
 
 
+def start_uds_frontend(daemon, path: str,
+                       request_timeout_s: float = 60.0
+                       ) -> ServeUdsFrontend:
+    """Bind the line-JSON wire on a Unix socket at `path` and serve on
+    a background thread.  A stale socket file from a previous process
+    is unlinked first (the bind would fail on it); the live socket is
+    left for the OS/operator on shutdown, like any pidfile-adjacent
+    artifact."""
+    path = os.fspath(path)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass  # no stale socket — the common case
+    srv = ServeUdsFrontend(path, _Handler)
+    srv.serving_daemon = daemon
+    srv.request_timeout_s = float(request_timeout_s)
+    t = threading.Thread(target=srv.serve_forever,
+                         name="lgbm-serve-uds", daemon=True)
+    t.start()
+    log.info(f"Serving UDS front end listening on {path}")
+    return srv
+
+
 class LineClient:
     """One line-JSON connection to a replica, with
     reconnect-with-backoff (ISSUE 13 satellite: a dropped TCP
@@ -197,16 +230,40 @@ class LineClient:
     retry, which for predicts the router does, on a different
     replica)."""
 
-    def __init__(self, host: str, port: int,
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None,
                  connect_timeout_s: float = 5.0,
-                 backoff_ms: float = 25.0, max_connect_attempts: int = 4):
+                 backoff_ms: float = 25.0, max_connect_attempts: int = 4,
+                 uds_path: Optional[str] = None):
+        if (uds_path is None) == (host is None or port is None):
+            raise ValueError("LineClient needs either host+port (TCP) or "
+                             "uds_path (Unix socket)")
         self.host = host
-        self.port = int(port)
+        self.port = int(port) if port is not None else None
+        self.uds_path = os.fspath(uds_path) if uds_path else None
         self._connect_timeout_s = float(connect_timeout_s)
         self._backoff_ms = float(backoff_ms)
         self._max_connect_attempts = max(int(max_connect_attempts), 1)
         self._sock: Optional[socket.socket] = None
         self._file = None
+
+    @property
+    def _peer(self) -> str:
+        return self.uds_path if self.uds_path is not None \
+            else f"{self.host}:{self.port}"
+
+    def _open_socket(self, timeout: float) -> socket.socket:
+        if self.uds_path is None:
+            return socket.create_connection((self.host, self.port),
+                                            timeout=timeout)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.settimeout(timeout)
+            s.connect(self.uds_path)
+        except OSError:
+            s.close()
+            raise
+        return s
 
     # ------------------------------------------------------------ plumbing
     def _connect(self, deadline: Optional[float]) -> None:
@@ -220,8 +277,7 @@ class LineClient:
                 if deadline is not None:
                     timeout = min(timeout,
                                   max(deadline - time.monotonic(), 0.05))
-                self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=timeout)
+                self._sock = self._open_socket(timeout)
                 self._file = self._sock.makefile("rwb")
                 return
             except OSError as e:
@@ -231,7 +287,7 @@ class LineClient:
                     time.sleep(delay)
                     delay *= 2
         raise ConnectionError(
-            f"could not connect to {self.host}:{self.port} within "
+            f"could not connect to {self._peer} within "
             f"{self._max_connect_attempts} attempts: {last}")
 
     def close(self) -> None:
@@ -270,16 +326,16 @@ class LineClient:
         except (OSError, ValueError) as e:
             self.close()
             raise ConnectionError(
-                f"connection to {self.host}:{self.port} failed "
+                f"connection to {self._peer} failed "
                 f"mid-request: {e}") from e
         if not line:
             self.close()
             raise ConnectionError(
-                f"connection to {self.host}:{self.port} closed by peer")
+                f"connection to {self._peer} closed by peer")
         try:
             return json.loads(line)
         except ValueError as e:
             self.close()
             raise ConnectionError(
-                f"malformed reply from {self.host}:{self.port}: "
+                f"malformed reply from {self._peer}: "
                 f"{line[:128]!r}") from e

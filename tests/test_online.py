@@ -1,0 +1,310 @@
+"""Online continual-learning suite (docs/Online.md): chunk-source
+sequencing, the OnlineTrainer loop (boost/refit/auto), per-generation
+checkpoint + atomic publish, the failure semantics (corrupt chunk ->
+skip, failed publish -> retry with the old generation serving), and
+byte-exact resume across a mid-loop stop.
+
+The byte-identity oracle is the same as the serving suite's: a
+published generation must serve exactly `Booster.predict` of the model
+text the trainer checkpointed — any tolerance would hide a torn publish
+or a stale pack."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.registry import global_registry
+from lightgbm_tpu.online import (DirectoryChunkSource, LocalPublisher,
+                                 MemoryChunkSource, OnlineTrainer,
+                                 write_chunk)
+from lightgbm_tpu.reliability import faults
+from lightgbm_tpu.serving import ModelRegistry
+
+_PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+           "min_data_in_leaf": 5, "device_predict": "true",
+           "device_predict_min_bucket": 32, "serve_warmup": False,
+           "online_trees_per_chunk": 2, "online_publish_backoff_ms": 1.0}
+
+
+def _mk(n, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] > 0)).astype(np.float32)
+    return X, y
+
+
+def _registry():
+    return ModelRegistry(min_bucket=32, warmup_rows=64, warmup=False)
+
+
+def _reset_counters():
+    for key in ("online_generations_published",
+                "online_generations_skipped", "online_publish_retries"):
+        global_registry.inc(key, -global_registry.counter(key))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    _reset_counters()
+    monkeypatch.delenv("LGBM_TPU_FAULT", raising=False)
+    faults.reload()
+    yield
+    faults.reload()
+
+
+# ---------------------------------------------------------------- sources
+def test_memory_source_monotone_generations():
+    src = MemoryChunkSource()
+    X, y = _mk(10)
+    assert src.poll() is None
+    assert src.push(X, y) == 1
+    assert src.push(X, y) == 2
+    c1, c2 = src.poll(), src.poll()
+    assert (c1.generation, c2.generation) == (1, 2)
+    assert c1.ok and c1.num_rows == 10
+    assert src.poll() is None
+    with pytest.raises(ValueError):
+        src.push(X[:0], y[:0])
+
+
+def test_directory_source_orders_and_ignores_partials(tmp_path):
+    d = str(tmp_path)
+    X, y = _mk(8)
+    # out-of-order landing + junk the watcher must never surface
+    write_chunk(d, 2, X, y)
+    write_chunk(d, 1, X, y)
+    (tmp_path / "chunk-0000003.npz.12345.tmp").write_bytes(b"partial")
+    (tmp_path / ".chunk-0000004.npz").write_bytes(b"hidden")
+    (tmp_path / "notes.txt").write_text("ignored")
+    src = DirectoryChunkSource(d)
+    c = src.poll()
+    assert c.generation == 1 and c.ok
+    assert np.array_equal(c.X, X) and np.array_equal(c.y, y)
+    assert src.poll().generation == 2
+    assert src.poll() is None
+    # a resumed cursor never re-reads consumed generations
+    src2 = DirectoryChunkSource(d, start_generation=2)
+    assert src2.poll().generation == 2
+    assert src2.poll() is None
+
+
+def test_directory_source_csv_and_npy_label_first_column(tmp_path):
+    X, y = _mk(6)
+    mat = np.column_stack([y, X]).astype(np.float64)
+    np.savetxt(tmp_path / "chunk-0000001.csv", mat, delimiter=",")
+    np.save(tmp_path / "chunk-0000002.npy", mat)
+    src = DirectoryChunkSource(str(tmp_path))
+    for gen in (1, 2):
+        c = src.poll()
+        assert c.generation == gen and c.ok
+        assert np.allclose(c.X, X) and np.allclose(c.y, y)
+
+
+def test_directory_source_torn_chunk_surfaces_error(tmp_path):
+    (tmp_path / "chunk-0000001.npz").write_bytes(b"not an npz at all")
+    src = DirectoryChunkSource(str(tmp_path))
+    c = src.poll()
+    assert c.generation == 1 and not c.ok and c.error
+    assert src.poll() is None  # monotone: the damaged gen is consumed
+
+
+# ---------------------------------------------------------------- trainer
+def test_trainer_boost_loop_publishes_and_checkpoints(tmp_path):
+    reg = _registry()
+    src = MemoryChunkSource()
+    seen = []
+    tr = OnlineTrainer(src, LocalPublisher(reg), params=dict(_PARAMS),
+                       checkpoint_dir=str(tmp_path),
+                       on_publish=lambda g, v, s: seen.append((g, v, s)))
+    src.push(*_mk(300, 1))
+    src.push(*_mk(300, 2))
+    tr.start()
+    assert tr.step() and tr.step()
+    assert not tr.step()  # source drained
+    assert reg.versions() == {"online": 2}
+    assert [(g, v) for g, v, _ in seen] == [(1, 1), (2, 2)]
+    # each published generation IS its checkpoint: byte-identical text
+    for gen, _v, model_str in seen:
+        on_disk = open(tmp_path / f"ckpt_{gen:07d}.txt").read()
+        assert on_disk == model_str
+    # the published entry serves exactly Booster.predict of that text
+    Xq = _mk(40, 9)[0]
+    entry = reg.get("online")
+    try:
+        got = np.asarray(entry.predictor.predict(Xq))
+    finally:
+        entry.release()
+    oracle = lgb.Booster(model_str=seen[-1][2])
+    oracle._gbdt.config.device_predict = "true"  # same path as serving
+    exp = oracle.predict(Xq)
+    assert np.array_equal(got, exp)
+    stats = tr.stats()
+    assert stats["generations_published"] == 2
+    assert stats["generation"] == 2
+    assert stats["freshness_lag_s"] is not None \
+        and stats["freshness_lag_s"] > 0
+    assert global_registry.gauge("model_freshness_lag_s") is not None
+
+
+def test_auto_mode_refits_small_chunks_boosts_large(tmp_path):
+    reg = _registry()
+    src = MemoryChunkSource()
+    tr = OnlineTrainer(src, LocalPublisher(reg),
+                       params={**_PARAMS, "online_mode": "auto"},
+                       checkpoint_dir=str(tmp_path))
+    src.push(*_mk(300, 1))     # first chunk always boosts (no model yet)
+    tr.start()
+    assert tr.step()
+    n0 = tr.booster.num_trees()
+    assert n0 == 2
+    src.push(*_mk(300, 2))     # 300 rows >= 2 trees -> boost
+    assert tr.step()
+    assert tr.booster.num_trees() == n0 + 2
+    b_before = tr.booster.model_to_string()
+    src.push(*_mk(3, 3))       # 3 rows < 4 trees -> refit in place
+    assert tr.step()
+    assert tr.booster.num_trees() == n0 + 2      # no new trees
+    assert tr.booster.model_to_string() != b_before  # leaves moved
+    assert reg.versions()["online"] == 3
+
+
+def test_publish_fail_fault_retries_and_lands(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FAULT", "online_publish_fail@1")
+    faults.reload()
+    reg = _registry()
+    src = MemoryChunkSource()
+    tr = OnlineTrainer(src, LocalPublisher(reg), params=dict(_PARAMS),
+                       checkpoint_dir=str(tmp_path))
+    src.push(*_mk(200, 1))
+    tr.start()
+    assert tr.step()
+    # first attempt raised (injected), the retry published — never a
+    # half-published model, never a lost generation
+    assert reg.versions() == {"online": 1}
+    assert global_registry.counter("online_publish_retries") == 1
+    assert global_registry.counter("online_generations_published") == 1
+    assert global_registry.counter("online_generations_skipped") == 0
+
+
+class _AlwaysFailPublisher:
+    def publish(self, name, model_str, path):
+        raise RuntimeError("publish target down")
+
+    def probe(self, name, rows):
+        raise RuntimeError("unreachable")
+
+
+def test_publish_exhausted_skips_and_keeps_old_generation(tmp_path):
+    reg = _registry()
+    src = MemoryChunkSource()
+    good = OnlineTrainer(src, LocalPublisher(reg), params=dict(_PARAMS),
+                         checkpoint_dir=str(tmp_path / "a"))
+    src.push(*_mk(200, 1))
+    good.start()
+    assert good.step()
+    assert reg.versions() == {"online": 1}
+    # a second trainer whose publisher is down: the generation is
+    # counted SKIPPED after the bounded retries and the registry still
+    # serves the old version untouched
+    src2 = MemoryChunkSource()
+    bad = OnlineTrainer(src2, _AlwaysFailPublisher(),
+                        params={**_PARAMS, "online_publish_retry_max": 1},
+                        checkpoint_dir=str(tmp_path / "b"))
+    src2.push(*_mk(200, 2))
+    bad.start()
+    assert bad.step()
+    assert reg.versions() == {"online": 1}          # old gen serving
+    assert global_registry.counter("online_generations_skipped") == 1
+    assert global_registry.counter("online_publish_retries") == 2
+
+
+def test_chunk_corrupt_fault_skips_generation(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FAULT", "online_chunk_corrupt@2")
+    faults.reload()
+    d = tmp_path / "chunks"
+    d.mkdir()
+    write_chunk(str(d), 1, *_mk(200, 1))
+    write_chunk(str(d), 2, *_mk(200, 2))
+    write_chunk(str(d), 3, *_mk(200, 3))
+    reg = _registry()
+    tr = OnlineTrainer(DirectoryChunkSource(str(d)), LocalPublisher(reg),
+                       params=dict(_PARAMS),
+                       checkpoint_dir=str(tmp_path / "ck"))
+    tr.start()
+    assert tr.step()                      # gen 1 publishes (v1)
+    assert tr.step()                      # gen 2 corrupt -> skipped
+    assert reg.versions() == {"online": 1}  # old generation kept serving
+    assert global_registry.counter("online_generations_skipped") == 1
+    assert tr.step()                      # gen 3 publishes (v2)
+    assert reg.versions() == {"online": 2}
+    assert tr.stats()["generation"] == 3
+
+
+def test_resume_from_checkpoint_is_byte_exact(tmp_path):
+    """A trainer stopped after generation 2 and relaunched must publish
+    its checkpoint FIRST (no served-version regression) and re-train
+    generation 3 into exactly the bytes the uninterrupted run
+    produced — generation N is a pure function of (model text N-1,
+    chunk bytes N)."""
+    d = tmp_path / "chunks"
+    d.mkdir()
+    for g in (1, 2, 3):
+        write_chunk(str(d), g, *_mk(250, 10 + g))
+    # control: all three generations in one process
+    reg_a = _registry()
+    tr_a = OnlineTrainer(DirectoryChunkSource(str(d)),
+                         LocalPublisher(reg_a), params=dict(_PARAMS),
+                         checkpoint_dir=str(tmp_path / "ck_a"))
+    tr_a.start()
+    assert tr_a.step() and tr_a.step() and tr_a.step()
+    final_a = tr_a.booster.model_to_string()
+    gen2_a = open(tmp_path / "ck_a" / "ckpt_0000002.txt").read()
+    # interrupted: generations 1-2, then the process "dies"
+    reg_b = _registry()
+    tr_b = OnlineTrainer(DirectoryChunkSource(str(d)),
+                         LocalPublisher(reg_b), params=dict(_PARAMS),
+                         checkpoint_dir=str(tmp_path / "ck_b"))
+    tr_b.start()
+    assert tr_b.step() and tr_b.step()
+    # relaunch: resume must land at generation 2 with identical bytes,
+    # publish it immediately, then consume ONLY generation 3
+    reg_c = _registry()
+    published = []
+    tr_c = OnlineTrainer(DirectoryChunkSource(str(d)),
+                         LocalPublisher(reg_c), params=dict(_PARAMS),
+                         checkpoint_dir=str(tmp_path / "ck_b"),
+                         on_publish=lambda g, v, s:
+                         published.append((g, v, s)))
+    tr_c.start()
+    assert tr_c.generation == 2
+    assert published and published[0][0] == 2     # resume re-publish
+    assert published[0][2] == gen2_a              # == control's gen 2
+    assert reg_c.versions() == {"online": 1}
+    assert tr_c.step()
+    assert not tr_c.step()   # generations 1-2 never re-consumed
+    assert tr_c.generation == 3
+    assert tr_c.booster.model_to_string() == final_a   # byte-exact
+    assert open(tmp_path / "ck_b" / "ckpt_0000003.txt").read() == final_a
+
+
+def test_freshness_slo_feeds_burn_tracker(tmp_path):
+    """online_max_lag_s wires the per-generation lag into the PR-14
+    SloTracker: skipped generations count against the error budget."""
+    reg = _registry()
+    src = MemoryChunkSource()
+    tr = OnlineTrainer(src, _AlwaysFailPublisher(),
+                       params={**_PARAMS, "online_max_lag_s": 5.0,
+                               "online_publish_retry_max": 0,
+                               "serve_slo_fast_window_s": 1.0,
+                               "serve_slo_slow_window_s": 2.0},
+                       checkpoint_dir=str(tmp_path))
+    assert tr.slo.enabled
+    for g in range(1, 4):
+        src.push(*_mk(120, g))
+        tr.start()
+        assert tr.step()
+    # every generation skipped -> both windows burn
+    assert tr.slo.evaluate() is True
+    assert global_registry.gauge("fleet_slo_burning") == 1.0

@@ -1,0 +1,122 @@
+"""Booster.refit interplay with serving and continued training
+(ISSUE 15 satellite): refit mutates leaf values IN PLACE, so it must
+bump the model-mutation counter — the same slice-keyed cache hazard the
+PR-10 DART fix closed — or device/native packs keep serving the stale
+leaves.  Plus refit -> checkpoint -> resume byte-exactness: the online
+loop's cheap-update path has to round-trip through the checkpoint
+machinery exactly."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.reliability import CheckpointManager
+
+_PARAMS = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+           "min_data_in_leaf": 5, "device_predict": "true",
+           "device_predict_min_bucket": 32}
+
+
+def _mk(n, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _train(rounds=6, seed=0):
+    X, y = _mk(400, seed=seed)
+    bst = lgb.train(dict(_PARAMS), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    bst._gbdt._sync_model()
+    return bst, X
+
+
+def _host_predict(bst, X):
+    g = bst._gbdt
+    prev = g.config.device_predict
+    g.config.device_predict = "false"
+    try:
+        return bst.predict(np.asarray(X, np.float64))
+    finally:
+        g.config.device_predict = prev
+
+
+def _fresh_device_oracle(bst):
+    """A cache-free booster built from the live model's text with the
+    device path forced: whatever IT predicts is what a correctly
+    invalidated cache must also predict, byte-for-byte (same float32
+    traversal, same packed layout)."""
+    b = lgb.Booster(model_str=bst.model_to_string(num_iteration=-1))
+    b._gbdt.config.device_predict = "true"
+    return b
+
+
+def test_refit_bumps_mutations_and_device_pack_repacks():
+    """Device predict must serve the refit leaves IMMEDIATELY after an
+    in-place refit: the slice-keyed device pack is only invalidated by
+    the mutation counter refit bumps (the PR-10 hazard).  The oracle is
+    a cache-free booster rebuilt from the refit model's text — stale
+    packs cannot match it byte-for-byte."""
+    bst, X = _train()
+    g = bst._gbdt
+    before_dev = bst.predict(X)            # device path (float32 input)
+    m0 = getattr(g, "_model_mutations", 0)
+    X2, y2 = _mk(400, seed=7)
+    bst.refit(X2, y2)
+    assert getattr(g, "_model_mutations", 0) == m0 + 1
+    after_dev = bst.predict(X)             # must repack, not reuse
+    assert not np.array_equal(after_dev, before_dev)  # leaves moved
+    assert np.array_equal(after_dev, _fresh_device_oracle(bst).predict(X))
+    # and the device result agrees with the float64 host traversal to
+    # float32 rounding (the two paths differ only in accumulator width)
+    assert np.allclose(np.asarray(after_dev, np.float64),
+                       _host_predict(bst, X), rtol=1e-5, atol=1e-5)
+
+
+def test_refit_invalidates_single_row_fast_cache():
+    bst, X = _train()
+    row = X[:1]
+    before = np.asarray(bst.predict(row))
+    # populate the single-row fast cache, then refit in place
+    _ = bst._single_row_fast_for(X.shape[1], 0, -1, False)
+    bst.refit(*_mk(400, seed=9))
+    after = np.asarray(bst.predict(row))
+    assert not np.array_equal(after, before)
+    assert np.array_equal(after,
+                          np.asarray(_fresh_device_oracle(bst)
+                                     .predict(row)))
+
+
+def test_refit_checkpoint_resume_byte_exact(tmp_path):
+    """refit -> checkpoint -> reload must reproduce the refit model's
+    trees byte-for-byte, and CONTINUED TRAINING from the reloaded model
+    must equal continued training from the live refit booster — the
+    exact interplay the online loop's refit+boost mix exercises."""
+    bst, X = _train()
+    bst.refit(*_mk(400, seed=11))
+    mgr = CheckpointManager(str(tmp_path), params=dict(_PARAMS))
+    ck = mgr.save(bst, 1)
+    reloaded = lgb.Booster(model_file=ck.model_path)
+    live_txt = bst.model_to_string(num_iteration=-1)
+    assert _trees_of(reloaded.model_to_string(num_iteration=-1)) \
+        == _trees_of(live_txt)
+    # verified resumable: digests intact, params hash matches
+    ck2 = mgr.resumable(dict(_PARAMS))
+    assert ck2 is not None and ck2.iteration == 1
+    # continued training: live refit booster vs checkpoint round trip
+    Xc, yc = _mk(400, seed=12)
+    cont_live = lgb.train(dict(_PARAMS), lgb.Dataset(Xc, label=yc),
+                          num_boost_round=2, init_model=bst)
+    cont_ck = lgb.train(dict(_PARAMS), lgb.Dataset(Xc, label=yc),
+                        num_boost_round=2, init_model=ck.model_path)
+    assert _trees_of(cont_live.model_to_string()) \
+        == _trees_of(cont_ck.model_to_string())
+    # and the continued models serve identically on the device path
+    assert np.array_equal(cont_live.predict(X), cont_ck.predict(X))
+
+
+def _trees_of(model_txt: str) -> str:
+    """The tree section of a model text (everything before the embedded
+    `parameters:` block, which a load/serialize round trip may
+    normalize — the trees are the byte-exactness contract)."""
+    return model_txt.split("\nparameters:", 1)[0]

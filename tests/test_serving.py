@@ -579,3 +579,146 @@ def test_cli_serve_end_to_end(tmp_path):
         if proc.poll() is None:
             proc.kill()
     assert proc.returncode in (-signal.SIGTERM, 143)
+
+
+# -------------------------------------------------- adaptive coalescing
+def test_adaptive_coalesce_wait_decision():
+    """The EWMA decision function (docs/Serving.md): static mode keeps
+    the configured window unconditionally; adaptive mode keeps it under
+    burst (EWMA gap <= window) and shrinks to 0 when arrivals are
+    sparse (gap beyond the window) or unknown (no history)."""
+    from lightgbm_tpu.serving import Coalescer
+    static = Coalescer(max_wait_ms=40.0)
+    assert static.effective_wait_s() == pytest.approx(0.040)
+    c = Coalescer(max_wait_ms=40.0, adaptive=True)
+    assert c.effective_wait_s() == 0.0          # no history yet
+    with c._lock:
+        c._ewma_gap_s = 0.001                   # burst: 1 ms gaps
+    assert c.effective_wait_s() == pytest.approx(0.040)
+    with c._lock:
+        c._ewma_gap_s = 0.300                   # sparse: 300 ms gaps
+    assert c.effective_wait_s() == 0.0
+
+
+def test_adaptive_coalesce_sparse_p50_drops_vs_static():
+    """Sparse sequential load: the static window makes EVERY request
+    wait out serve_max_coalesce_wait_ms for batch-mates that never
+    come; the adaptive window learns the arrival gap exceeds the
+    window and dispatches immediately — p50 drops by at least the
+    window."""
+    bst, X = _train()
+
+    def run(adaptive):
+        d = _daemon(serve_max_coalesce_wait_ms=60.0,
+                    serve_adaptive_coalesce="auto" if adaptive else "off")
+        d.registry.register("m", booster=bst, block=True)
+        try:
+            lats = []
+            for i in range(8):
+                fut = d.submit("m", X[i:i + 1])
+                fut.result(timeout=60)
+                lats.append(fut.latency_ms)
+                time.sleep(0.09)     # arrival gap 90 ms > 60 ms window
+            return float(np.median(lats))
+        finally:
+            d.stop(drain=True, timeout=10)
+
+    static_p50 = run(adaptive=False)
+    adaptive_p50 = run(adaptive=True)
+    assert static_p50 >= 55.0         # every request waits the window
+    assert adaptive_p50 < static_p50 - 40.0
+
+
+def test_adaptive_coalesce_burst_batches_unchanged():
+    """Burst load: once the EWMA has seen burst-rate gaps, adaptive
+    mode keeps the FULL static window, so bursts coalesce into the
+    same fused dispatches as the static config (the batching
+    efficiency the window exists to buy)."""
+    bst, X = _train()
+
+    def run(adaptive):
+        from lightgbm_tpu.observability.registry import global_registry
+        serve_counters_reset()
+        d = _daemon(serve_max_coalesce_wait_ms=40.0,
+                    serve_adaptive_coalesce="auto" if adaptive else "off")
+        d.registry.register("m", booster=bst, block=True)
+        try:
+            for _round in range(3):   # round 0 warms the EWMA
+                futs = [d.submit("m", X[i:i + 1]) for i in range(12)]
+                for fut in futs:
+                    fut.result(timeout=60)
+            reqs = global_registry.counter("serve_requests")
+            disp = global_registry.counter("serve_dispatches")
+            return reqs / max(disp, 1)
+        finally:
+            d.stop(drain=True, timeout=10)
+
+    static_ratio = run(adaptive=False)
+    adaptive_ratio = run(adaptive=True)
+    # both must coalesce bursts into fused dispatches (>= 2 requests
+    # per dispatch on average), adaptive no worse than ~half static
+    assert static_ratio >= 2.0
+    assert adaptive_ratio >= 2.0
+    assert adaptive_ratio >= 0.5 * static_ratio
+
+
+# ------------------------------------------------------- UDS front end
+def test_uds_frontend_round_trip_and_drain(tmp_path):
+    """The Unix-socket front end speaks the SAME wire as TCP: predict
+    (byte-identical to Booster.predict), health, metrics and publish
+    all answer; after a drain-stop the daemon rejects instead of
+    wedging the socket."""
+    from lightgbm_tpu.serving import start_uds_frontend
+    bst, X = _train()
+    d = _daemon()
+    d.registry.register("m", booster=bst, block=True)
+    sock = str(tmp_path / "serve.sock")
+    srv = start_uds_frontend(d, sock, request_timeout_s=60.0)
+    try:
+        c = ServingClient.connect_uds(sock)
+        got = c.predict("m", X[:5])
+        assert np.array_equal(got, bst.predict(X[:5]))  # byte-identical
+        h = c.health()
+        assert h["ready"] and h["models"] == {"m": 1}
+        assert "m" in c.models()
+        assert c.stats()["serve_requests"] >= 1
+        # op=publish over the same socket: the rollout hook works on
+        # UDS exactly like TCP (same handler)
+        model2 = tmp_path / "m2.txt"
+        bst2, _ = _train(rounds=4, seed=3)
+        bst2.save_model(str(model2))
+        from lightgbm_tpu.serving import LineClient
+        lc = LineClient(uds_path=sock)
+        reply = lc.request({"op": "publish", "model": "m",
+                            "path": str(model2)}, timeout_s=120)
+        assert reply["ok"] and reply["version"] == 2
+        got2 = c.predict("m", X[:5])
+        assert np.array_equal(got2, bst2.predict(X[:5]))
+        reply = lc.request({"op": "metrics"}, timeout_s=30)
+        assert reply["ok"] and "lgbm_serve_requests" in reply["metrics"]
+        lc.close()
+        # drain: stop the daemon, the socket answers a structured error
+        d.stop(drain=True, timeout=10)
+        reply = LineClient(uds_path=sock).request(
+            {"model": "m", "rows": X[:1].tolist()}, timeout_s=30)
+        assert not reply["ok"] and "error" in reply
+        c.close()
+    finally:
+        srv.shutdown()
+        d.stop(drain=False)
+
+
+def test_uds_stale_socket_is_replaced(tmp_path):
+    from lightgbm_tpu.serving import start_uds_frontend
+    bst, X = _train()
+    sock = str(tmp_path / "serve.sock")
+    open(sock, "w").close()           # stale file where the socket goes
+    d = _daemon()
+    d.registry.register("m", booster=bst, block=True)
+    srv = start_uds_frontend(d, sock)
+    try:
+        got = ServingClient.connect_uds(sock).predict("m", X[:2])
+        assert np.array_equal(got, bst.predict(X[:2]))
+    finally:
+        srv.shutdown()
+        d.stop(drain=True, timeout=10)

@@ -28,12 +28,15 @@ from typing import List
 from ..callgraph import cached_walk, module_info_for
 from ..core import Finding, LintContext, Rule, register
 
-_SCOPE_PREFIXES = ("reliability",)
+_SCOPE_PREFIXES = ("reliability", "online")
 # terminal-artifact writers outside reliability/: the flight recorder's
 # stall/crash/SIGUSR2 dumps are read by the same supervisor machinery
 # as the stall diagnosis, so they obey the same torn-file discipline;
 # the tracing layer joins the scope with it (assembled waterfalls ride
-# the same dump path and must never land torn)
+# the same dump path and must never land torn).  online/ is in scope
+# because chunk files and published model paths are read by OTHER
+# processes (the watcher, replica loads) — a torn write there serves
+# a half-published model or trains on half a chunk.
 _SCOPE_FILES = {"observability/flightrec.py",
                 "observability/tracing.py"}
 _WRITE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b", "r+", "r+b", "rb+",

@@ -9,12 +9,14 @@ hot tree-growth path every such literal is a latent recompile or an
 accidental f64/i64 promotion under `jax_enable_x64`, so device code
 spells dtypes out.
 
-Scope: learner/, ops/, parallel/, inference/, serving/, io/device_bin.py,
-plus the observability modules that sit against the device runtime
-(costmodel.py harvests lowered programs, watchdog.py fingerprints jitted
-calls) — the modules whose arrays feed jitted programs (serving/
-coalesces and dispatches request buckets through them).  Host-side code
-(metrics, plotting, IO parsing) may rely on NumPy-style defaults.
+Scope: learner/, ops/, parallel/, inference/, serving/, online/,
+io/device_bin.py, plus the observability modules that sit against the
+device runtime (costmodel.py harvests lowered programs, watchdog.py
+fingerprints jitted calls) — the modules whose arrays feed jitted
+programs (serving/ coalesces and dispatches request buckets through
+them; online/ feeds chunks into training and probe rows into the
+serving dispatch).  Host-side code (metrics, plotting, IO parsing) may
+rely on NumPy-style defaults.
 """
 
 from __future__ import annotations
@@ -29,7 +31,8 @@ from ..core import Finding, LintContext, Rule, register
 # dtype (e.g. jnp.zeros(shape, dtype) -> 2)
 CONSTRUCTORS = {"zeros": 2, "ones": 2, "full": 3, "arange": 4,
                 "array": 2, "empty": 2, "eye": 3}
-SCOPE_DIRS = ("learner", "ops", "parallel", "inference", "serving")
+SCOPE_DIRS = ("learner", "ops", "parallel", "inference", "serving",
+              "online")
 SCOPE_FILES = {os.path.join("io", "device_bin.py"),
                os.path.join("observability", "costmodel.py"),
                os.path.join("observability", "watchdog.py"),

@@ -52,6 +52,11 @@ step "tracing + fleet observability suite (tests/test_tracing.py)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
 
+step "online continual-learning suite (tests/test_online.py + refit)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_online.py \
+    tests/test_refit_serving.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || fail=1
+
 step "serving bench smoke (bench.py --serve --smoke)"
 JAX_PLATFORMS=cpu python bench.py --serve --smoke || fail=1
 
@@ -62,6 +67,15 @@ step "fleet bench smoke (bench.py --serve-fleet --smoke)"
 # replicas contributing), >= 1 assembled cross-process trace, and the
 # serve_slow stall fires >= 1 slo_burn
 JAX_PLATFORMS=cpu python bench.py --serve-fleet --smoke || fail=1
+
+step "online continual-learning bench smoke (bench.py --online --smoke)"
+# gates (ISSUE 15): >= 3 generations published under sustained load
+# with ZERO lost client requests, responses byte-identical to the
+# generation that served them, freshness lag finite and under
+# online_max_lag_s, the chaos spec (publish-fail retried, corrupt
+# chunk skipped), and the mid-loop SIGTERM kill/resume drill
+# (byte-exact resume, no served-version regression)
+JAX_PLATFORMS=cpu python bench.py --online --smoke || fail=1
 
 if [[ "${1:-}" != "--quick" ]]; then
     step "tier-1 (full suite, 870 s cap)"
